@@ -1,0 +1,26 @@
+"""photon-tpu: a TPU-native framework for large-scale GLM and GLMix (GAME) training.
+
+A from-scratch JAX/XLA re-design of the capabilities of LinkedIn's Photon ML
+(reference: /root/reference, Spark/Scala). The compute path is jit/vmap/pjit over
+a `jax.sharding.Mesh`; distributed gradient reductions are XLA collectives (psum)
+instead of Spark treeAggregate; per-entity random-effect solves are vmapped
+fixed-shape batched optimizations instead of RDD mapValues loops.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+- ``photon_tpu.ops``       — pointwise losses, objective functions, linalg (photon-lib function/)
+- ``photon_tpu.optim``     — L-BFGS / OWL-QN / L-BFGS-B / TRON, trackers (photon-lib optimization/)
+- ``photon_tpu.parallel``  — mesh construction, sharded objective wrappers (Spark treeAggregate role)
+- ``photon_tpu.data``      — batches, index maps, stats, normalization, bucketing (photon-api data/)
+- ``photon_tpu.models``    — Coefficients, GLMs, GameModel (photon-lib/api model/)
+- ``photon_tpu.algorithm`` — coordinates + coordinate descent (photon-lib/api algorithm/)
+- ``photon_tpu.evaluation``— AUC/RMSE/P@k evaluators (photon-lib/api evaluation/)
+- ``photon_tpu.hyperparameter`` — Sobol + GP Bayesian search (photon-lib hyperparameter/)
+- ``photon_tpu.io``        — Avro codec, model/data I/O (photon-client data/avro/)
+- ``photon_tpu.estimators``— GameEstimator / GameTransformer (photon-api estimators/)
+- ``photon_tpu.cli``       — training / scoring / indexing drivers (photon-client)
+"""
+
+__version__ = "0.1.0"
+
+from photon_tpu.types import TaskType  # noqa: F401

@@ -1,0 +1,3 @@
+from photon_tpu.algorithm.fixed_effect import FixedEffectCoordinate  # noqa: F401
+from photon_tpu.algorithm.random_effect import RandomEffectCoordinate  # noqa: F401
+from photon_tpu.algorithm.coordinate_descent import CoordinateDescent  # noqa: F401

@@ -1,0 +1,86 @@
+"""Fixed-effect coordinate: one global GLM over the whole (sharded) batch.
+
+Parity target: reference ``FixedEffectCoordinate`` (photon-api
+algorithm/FixedEffectCoordinate.scala:31-152: train via
+DistributedOptimizationProblem.runWithSampling + broadcast model; score =
+map-side dot with broadcast coefficients) and ``DistributedOptimizationProblem``
+(optimization/DistributedOptimizationProblem.scala:140: optional down-sampling,
+variance computation).
+
+TPU-first: the batch lives sharded over the mesh's data axis; the whole
+optimizer run is one jitted program (w replicated by sharding rule — no
+broadcast step exists). Down-sampling is a weight mask (shapes stay static).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.algorithm.coordinate import Coordinate
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.game import FixedEffectModel
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.common import OptimizeResult
+from photon_tpu.optim.factory import OptimizerSpec, make_optimizer
+from photon_tpu.sampling.down_sampler import DownSampler
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class FixedEffectCoordinate(Coordinate):
+    coordinate_id: str
+    feature_shard: str
+    task: TaskType
+    objective: GLMObjective
+    optimizer_spec: OptimizerSpec = dataclasses.field(default_factory=OptimizerSpec)
+    down_sampler: Optional[DownSampler] = None
+    compute_variance: bool = False
+    dim: Optional[int] = None  # inferred from the batch if None
+
+    def train(
+        self,
+        batch: GameBatch,
+        residual_scores: Optional[Array] = None,
+        initial_model: Optional[FixedEffectModel] = None,
+    ) -> Tuple[FixedEffectModel, OptimizeResult]:
+        lb = batch.labeled_batch(self.feature_shard, residual_scores)
+        if self.down_sampler is not None:
+            # Down-sampling as reweighting mask — static shapes
+            # (DistributedOptimizationProblem.runWithSampling:140-166 role).
+            lb = self.down_sampler.apply(lb)
+        d = lb.dim
+        w0 = (
+            initial_model.model.coefficients.means
+            if initial_model is not None
+            else jnp.zeros((d,), lb.label.dtype)
+        )
+        solve = make_optimizer(self.objective, self.optimizer_spec)
+        result = solve(w0, lb)
+        variances = None
+        if self.compute_variance:
+            # Variance via inverse diagonal Hessian
+            # (DistributedOptimizationProblem.scala:83-103 SIMPLE mode).
+            diag = self.objective.hessian_diagonal(result.w, lb)
+            variances = 1.0 / jnp.maximum(diag, 1e-12)
+        model = FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(result.w, variances), self.task),
+            self.feature_shard,
+        )
+        return model, result
+
+    def score(self, model: FixedEffectModel, batch: GameBatch) -> Array:
+        return model.score(batch)
+
+    def zero_model(self) -> FixedEffectModel:
+        assert self.dim is not None, "dim required for zero_model"
+        return FixedEffectModel(
+            GeneralizedLinearModel.zeros(self.dim, self.task), self.feature_shard
+        )

@@ -1,0 +1,1 @@
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures  # noqa: F401

@@ -1,0 +1,53 @@
+"""GAME data containers: multi-shard batches with entity ids.
+
+Parity target: reference ``GameDatum`` (response/offset/weight + per-shard
+feature vectors + id-tag map, photon-api data/GameDatum.scala:37-68) and the
+``RDD[(UniqueSampleId, GameDatum)]`` game dataset.
+
+TPU-first design: one struct-of-arrays ``GameBatch`` holds every sample's
+label/offset/weight, a feature matrix per feature shard, and a dense int32
+entity index per random-effect type. Entity ids are interned to [0, E) at
+ingest (see photon_tpu.data.index_map.EntityIndex); -1 marks entities unseen
+at training time (cold start → that coordinate contributes score 0, matching
+the reference's behavior of missing random-effect models). Residual exchange
+between coordinates is pure array arithmetic on aligned score vectors — the
+reference's outer-join score algebra (DataScores.scala:33-157) disappears.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import Features, LabeledBatch
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GameBatch:
+    """All samples for training/scoring, aligned on a single sample axis."""
+
+    label: Array
+    offset: Array
+    weight: Array
+    features: Dict[str, Features]  # feature-shard name -> (n, d_shard)
+    entity_ids: Dict[str, Array]  # RE type name -> (n,) int32 dense entity idx
+    uid: Optional[Array] = None
+
+    @property
+    def n(self) -> int:
+        return self.label.shape[0]
+
+    def labeled_batch(self, shard: str, extra_offset: Optional[Array] = None) -> LabeledBatch:
+        """Project to a single-shard LabeledBatch
+        (GameDatum.generateLabeledPointWithFeatureShardId role)."""
+        offset = self.offset if extra_offset is None else self.offset + extra_offset
+        return LabeledBatch(self.label, self.features[shard], offset, self.weight, self.uid)
+
+    def with_offset(self, offset: Array) -> "GameBatch":
+        return dataclasses.replace(self, offset=offset)

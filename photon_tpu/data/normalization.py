@@ -1,0 +1,112 @@
+"""Feature normalization, folded algebraically into the objective.
+
+Parity target: ``NormalizationContext`` (reference photon-lib
+normalization/NormalizationContext.scala:37-131) and the algebraic fold the
+reference derives in ValueAndGradientAggregator.scala:41-148: features are
+never materialized in normalized form. With per-feature factors ``f`` and
+shifts ``s`` (intercept untouched), the normalized margin is
+
+    x'·w = Σ_j (x_j - s_j) f_j w_j + w_int
+         = x·(f∘w) + (w_int - Σ_j w_j f_j s_j)
+
+so training only needs the *effective coefficients* ``ew = f∘w`` and a scalar
+*total shift* ``es = -(s·ew)``. In JAX this fold is two fused elementwise ops
+in front of the margin matmul — autodiff then yields the correctly-folded
+gradient/Hessian for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.types import NormalizationType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """factors/shifts for one feature shard. ``factors[j] == 1`` and
+    ``shifts[j] == 0`` at the intercept (and for NONE normalization).
+
+    ``intercept_index`` is static metadata (reference shiftsAndInterceptOpt).
+    """
+
+    factors: Optional[Array] = None
+    shifts: Optional[Array] = None
+    intercept_index: Optional[int] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    def effective(self, w: Array) -> Tuple[Array, Array]:
+        """(ew, es): effective coefficients and total scalar shift."""
+        ew = w if self.factors is None else w * self.factors
+        es = jnp.zeros((), w.dtype) if self.shifts is None else -jnp.dot(self.shifts, ew)
+        return ew, es
+
+    def transformed_to_model_space(self, w: Array) -> Array:
+        """Map coefficients trained against normalized features back to the
+        original feature space (NormalizationContext.scala model↔transformed
+        conversions)."""
+        ew, es = self.effective(w)
+        if self.intercept_index is not None and self.shifts is not None:
+            ew = ew.at[self.intercept_index].add(es)
+        return ew
+
+    def model_to_transformed_space(self, w: Array) -> Array:
+        out = w
+        if self.intercept_index is not None and self.shifts is not None:
+            out = out.at[self.intercept_index].add(jnp.dot(self.shifts, w))
+        if self.factors is not None:
+            out = out / self.factors
+        return out
+
+
+def build_normalization_context(
+    norm_type: NormalizationType,
+    mean: Array,
+    std: Array,
+    max_magnitude: Array,
+    intercept_index: Optional[int],
+) -> NormalizationContext:
+    """Build a context from feature statistics (reference
+    NormalizationContextFactory semantics; stats from FeatureDataStatistics).
+
+    - SCALE_WITH_STANDARD_DEVIATION: factor = 1/std
+    - SCALE_WITH_MAX_MAGNITUDE:      factor = 1/max|x|
+    - STANDARDIZATION:               factor = 1/std, shift = mean (requires intercept)
+    """
+    def _safe_inv(a: Array) -> Array:
+        return jnp.where(a > 0, 1.0 / jnp.where(a > 0, a, 1.0), 1.0)
+
+    if norm_type == NormalizationType.NONE:
+        return NormalizationContext(None, None, intercept_index)
+
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors = _safe_inv(std)
+    elif norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors = _safe_inv(jnp.abs(max_magnitude))
+    elif norm_type == NormalizationType.STANDARDIZATION:
+        if intercept_index is None:
+            raise ValueError("STANDARDIZATION requires an intercept feature")
+        factors = _safe_inv(std)
+    else:
+        raise ValueError(f"unknown normalization type {norm_type}")
+
+    shifts = None
+    if norm_type == NormalizationType.STANDARDIZATION:
+        shifts = mean
+    if intercept_index is not None:
+        factors = factors.at[intercept_index].set(1.0)
+        if shifts is not None:
+            shifts = shifts.at[intercept_index].set(0.0)
+    return NormalizationContext(factors, shifts, intercept_index)

@@ -1,0 +1,15 @@
+from photon_tpu.evaluation.evaluators import (  # noqa: F401
+    EvaluatorType,
+    auc_roc,
+    auc_pr,
+    rmse,
+    logistic_loss_metric,
+    poisson_loss_metric,
+    squared_loss_metric,
+    precision_at_k,
+    evaluate,
+    grouped_auc,
+    grouped_precision_at_k,
+    metric_is_better,
+)
+from photon_tpu.evaluation.suite import EvaluationSuite  # noqa: F401

@@ -1,0 +1,265 @@
+"""Validation metrics: AUC / AUPR / RMSE / losses / Precision@k + grouped
+(multi-) evaluators.
+
+Parity targets: reference evaluator implementations in photon-api
+evaluation/ (AreaUnderROCCurveEvaluator + Local/Multi variants with weighted
+trapezoid AUC AreaUnderROCCurveLocalEvaluator.scala:26-72, RMSEEvaluator,
+SquaredLossEvaluator, LogisticLossEvaluator, PoissonLossEvaluator,
+PrecisionAtK{Local,Multi}Evaluator) and the lib-level Evaluator /
+MultiEvaluator machinery (photon-lib evaluation/MultiEvaluator.scala:36-72,
+EvaluatorType.scala:59-64 with direction-of-better op).
+
+TPU-first design (SURVEY.md §7 hard part #2 — distributed AUC without a
+driver-side sort): metrics are computed fully on device. AUC handles weighted
+samples and score ties exactly via a sort + tie-group segment-sum formulation
+(equivalent to the weighted trapezoid over the ROC curve). Grouped
+("multi") evaluators take a dense group-id vector and use one shared sort +
+segment reductions — the reference's groupBy(id)+local-metric pattern with
+the shuffle replaced by segment ops.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.ops.losses import LogisticLoss, PoissonLoss, SquaredLoss
+
+Array = jax.Array
+
+
+class EvaluatorType(enum.Enum):
+    AUC = "AUC"
+    AUPR = "AUPR"
+    RMSE = "RMSE"
+    SQUARED_LOSS = "SQUARED_LOSS"
+    LOGISTIC_LOSS = "LOGISTIC_LOSS"
+    POISSON_LOSS = "POISSON_LOSS"
+    PRECISION_AT_K = "PRECISION_AT_K"
+
+
+# Direction-of-better per metric (reference EvaluatorType.op :59-64).
+_LARGER_IS_BETTER = {
+    EvaluatorType.AUC: True,
+    EvaluatorType.AUPR: True,
+    EvaluatorType.PRECISION_AT_K: True,
+    EvaluatorType.RMSE: False,
+    EvaluatorType.SQUARED_LOSS: False,
+    EvaluatorType.LOGISTIC_LOSS: False,
+    EvaluatorType.POISSON_LOSS: False,
+}
+
+
+def metric_is_better(etype: EvaluatorType) -> Callable[[float, float], bool]:
+    if _LARGER_IS_BETTER[etype]:
+        return lambda new, old: new > old
+    return lambda new, old: new < old
+
+
+def _default_weight(scores: Array, weight: Optional[Array]) -> Array:
+    return jnp.ones_like(scores) if weight is None else weight
+
+
+def _tie_groups(sorted_scores: Array) -> Array:
+    """Dense group id per sorted element; equal scores share a group."""
+    new_group = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         (sorted_scores[1:] != sorted_scores[:-1]).astype(jnp.int32)]
+    )
+    return jnp.cumsum(new_group) - 1
+
+
+def auc_roc(scores: Array, labels: Array, weight: Optional[Array] = None) -> Array:
+    """Weighted ROC AUC with exact tie handling.
+
+    AUC = Σ_pos w_p · (W_neg,below(p) + ½·W_neg,tied(p)) / (W_pos · W_neg) —
+    the probability a random positive outranks a random negative (ties count
+    ½), identical to the reference's weighted trapezoid
+    (AreaUnderROCCurveLocalEvaluator.scala:26-72).
+    """
+    w = _default_weight(scores, weight)
+    n = scores.shape[0]
+    order = jnp.argsort(scores)  # ascending
+    s, y, ww = scores[order], labels[order], w[order]
+    pos_w = jnp.where(y > 0, ww, 0.0)
+    neg_w = jnp.where(y > 0, 0.0, ww)
+
+    gid = _tie_groups(s)
+    group_neg = jax.ops.segment_sum(neg_w, gid, num_segments=n)
+    # Exclusive cumulative negative weight below each tie group.
+    cum_neg = jnp.cumsum(group_neg) - group_neg
+    frac = cum_neg[gid] + 0.5 * group_neg[gid]
+    Wp = jnp.sum(pos_w)
+    Wn = jnp.sum(neg_w)
+    return jnp.sum(pos_w * frac) / jnp.maximum(Wp * Wn, 1e-30)
+
+
+def auc_pr(scores: Array, labels: Array, weight: Optional[Array] = None) -> Array:
+    """Weighted area under the precision-recall curve (trapezoid between
+    distinct-score cut points, reference AreaUnderPRCurveEvaluator role)."""
+    w = _default_weight(scores, weight)
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)  # descending: threshold sweep
+    s, y, ww = scores[order], labels[order], w[order]
+    pos_w = jnp.where(y > 0, ww, 0.0)
+    neg_w = jnp.where(y > 0, 0.0, ww)
+    Wp = jnp.maximum(jnp.sum(pos_w), 1e-30)
+
+    # Cut points = ends of tie groups (scan includes the whole group).
+    cum_tp = jnp.cumsum(pos_w)
+    cum_fp = jnp.cumsum(neg_w)
+    is_group_end = jnp.concatenate(
+        [(s[1:] != s[:-1]), jnp.ones((1,), bool)]
+    )
+    recall = cum_tp / Wp
+    precision = cum_tp / jnp.maximum(cum_tp + cum_fp, 1e-30)
+    # Trapezoid over group-end points only; masked pairs contribute 0.
+    # Previous group-end index for each position, by running max over ends:
+    idx = jnp.arange(n)
+    prev_end = jax.lax.associative_scan(jnp.maximum, jnp.where(is_group_end, idx, -1))
+    prev_prev = jnp.concatenate([jnp.full((1,), -1, prev_end.dtype), prev_end[:-1]])
+    r_prev = jnp.where(prev_prev >= 0, recall[jnp.maximum(prev_prev, 0)], 0.0)
+    p_prev = jnp.where(prev_prev >= 0, precision[jnp.maximum(prev_prev, 0)], 1.0)
+    contrib = jnp.where(
+        is_group_end, (recall - r_prev) * 0.5 * (precision + p_prev), 0.0
+    )
+    return jnp.sum(contrib)
+
+
+def rmse(scores: Array, labels: Array, weight: Optional[Array] = None) -> Array:
+    w = _default_weight(scores, weight)
+    tot = jnp.maximum(jnp.sum(w), 1e-30)
+    return jnp.sqrt(jnp.sum(w * (scores - labels) ** 2) / tot)
+
+
+def _mean_pointwise(loss_fn, scores, labels, weight):
+    w = _default_weight(scores, weight)
+    tot = jnp.maximum(jnp.sum(w), 1e-30)
+    return jnp.sum(w * loss_fn(scores, labels)) / tot
+
+
+def squared_loss_metric(scores, labels, weight=None):
+    return _mean_pointwise(SquaredLoss.value, scores, labels, weight)
+
+
+def logistic_loss_metric(scores, labels, weight=None):
+    return _mean_pointwise(LogisticLoss.value, scores, labels, weight)
+
+
+def poisson_loss_metric(scores, labels, weight=None):
+    return _mean_pointwise(PoissonLoss.value, scores, labels, weight)
+
+
+def precision_at_k(scores: Array, labels: Array, k: int) -> Array:
+    """Unweighted P@k: fraction of positives among top-k scores
+    (PrecisionAtKLocalEvaluator role)."""
+    k = min(k, scores.shape[0])
+    _, top = jax.lax.top_k(scores, k)
+    return jnp.mean((labels[top] > 0).astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Grouped ("multi") evaluators: metric per group id, averaged over groups.
+# ---------------------------------------------------------------------------
+
+
+def grouped_auc(
+    scores: Array,
+    labels: Array,
+    group_ids: Array,
+    num_groups: int,
+    weight: Optional[Array] = None,
+) -> Array:
+    """Mean per-group weighted AUC (reference MultiEvaluator + AUC local:
+    groupBy(id) → local AUC → average). Groups lacking both classes are
+    excluded from the average, matching the reference's filtered groupBy.
+
+    One global lexicographic sort (group, score) + segment ops — no shuffle.
+    Samples with group id < 0 (cold-start marker) are excluded entirely.
+    """
+    w = _default_weight(scores, weight)
+    w = jnp.where(group_ids >= 0, w, 0.0)
+    group_ids = jnp.maximum(group_ids, 0)
+    n = scores.shape[0]
+    # Sort by (group asc, score asc): combine into a single sort key by
+    # sorting score first then stable-sorting group.
+    order1 = jnp.argsort(scores, stable=True)
+    g1 = group_ids[order1]
+    order = order1[jnp.argsort(g1, stable=True)]
+    s, y, ww, g = scores[order], labels[order], w[order], group_ids[order]
+
+    pos_w = jnp.where(y > 0, ww, 0.0)
+    neg_w = jnp.where(y > 0, 0.0, ww)
+
+    # Tie groups within (group, score).
+    new_tie = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         ((s[1:] != s[:-1]) | (g[1:] != g[:-1])).astype(jnp.int32)]
+    )
+    tid = jnp.cumsum(new_tie) - 1
+    tie_neg = jax.ops.segment_sum(neg_w, tid, num_segments=n)
+    cum_tie_neg = jnp.cumsum(tie_neg) - tie_neg  # exclusive, but global!
+    # Subtract each group's starting cumulative so counts are per-group.
+    grp_start_neg = jax.ops.segment_sum(neg_w, g, num_segments=num_groups)
+    cum_grp_neg = jnp.cumsum(grp_start_neg) - grp_start_neg  # exclusive per group id
+    below = cum_tie_neg[tid] - cum_grp_neg[g]
+    frac = below + 0.5 * tie_neg[tid]
+
+    num = jax.ops.segment_sum(pos_w * frac, g, num_segments=num_groups)
+    Wp = jax.ops.segment_sum(pos_w, g, num_segments=num_groups)
+    Wn = grp_start_neg
+    valid = (Wp > 0) & (Wn > 0)
+    auc_g = jnp.where(valid, num / jnp.maximum(Wp * Wn, 1e-30), 0.0)
+    return jnp.sum(auc_g) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def grouped_precision_at_k(
+    scores: Array, labels: Array, group_ids: Array, num_groups: int, k: int
+) -> Array:
+    """Mean per-group P@k (reference PrecisionAtKMultiEvaluator). Groups with
+    fewer than k samples use all their samples."""
+    # Rank within group: sort by (group, -score), positional rank per group.
+    order1 = jnp.argsort(-scores, stable=True)
+    g1 = group_ids[order1]
+    order = order1[jnp.argsort(g1, stable=True)]
+    y, g = labels[order], group_ids[order]
+    n = scores.shape[0]
+    idx = jnp.arange(n)
+    # Start index of each group's run.
+    is_start = jnp.concatenate([jnp.ones((1,), bool), g[1:] != g[:-1]])
+    start_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(is_start, idx, -1))
+    rank = idx - start_idx  # 0-based rank within group
+    in_top = rank < k
+    hits = jax.ops.segment_sum(jnp.where(in_top & (y > 0), 1.0, 0.0), g, num_segments=num_groups)
+    cnt = jax.ops.segment_sum(jnp.where(in_top, 1.0, 0.0), g, num_segments=num_groups)
+    present = jax.ops.segment_sum(jnp.ones_like(hits[g]), g, num_segments=num_groups) > 0
+    p = jnp.where(cnt > 0, hits / jnp.maximum(cnt, 1.0), 0.0)
+    return jnp.sum(jnp.where(present, p, 0.0)) / jnp.maximum(jnp.sum(present), 1)
+
+
+def evaluate(
+    etype: EvaluatorType,
+    scores: Array,
+    labels: Array,
+    weight: Optional[Array] = None,
+    k: int = 10,
+) -> Array:
+    """Single-evaluator dispatch (EvaluatorFactory role)."""
+    if etype == EvaluatorType.AUC:
+        return auc_roc(scores, labels, weight)
+    if etype == EvaluatorType.AUPR:
+        return auc_pr(scores, labels, weight)
+    if etype == EvaluatorType.RMSE:
+        return rmse(scores, labels, weight)
+    if etype == EvaluatorType.SQUARED_LOSS:
+        return squared_loss_metric(scores, labels, weight)
+    if etype == EvaluatorType.LOGISTIC_LOSS:
+        return logistic_loss_metric(scores, labels, weight)
+    if etype == EvaluatorType.POISSON_LOSS:
+        return poisson_loss_metric(scores, labels, weight)
+    if etype == EvaluatorType.PRECISION_AT_K:
+        return precision_at_k(scores, labels, k)
+    raise ValueError(f"unknown evaluator {etype}")

@@ -1,0 +1,8 @@
+from photon_tpu.hyperparameter.search import (  # noqa: F401
+    GaussianProcessSearch,
+    RandomSearch,
+)
+from photon_tpu.hyperparameter.kernels import RBF, Matern52  # noqa: F401
+from photon_tpu.hyperparameter.gp import GaussianProcessEstimator, GaussianProcessModel  # noqa: F401
+from photon_tpu.hyperparameter.criteria import confidence_bound, expected_improvement  # noqa: F401
+from photon_tpu.hyperparameter.tuner import HyperparameterTuner, get_tuner  # noqa: F401

@@ -1,0 +1,3 @@
+from photon_tpu.models.coefficients import Coefficients  # noqa: F401
+from photon_tpu.models.glm import GeneralizedLinearModel  # noqa: F401
+from photon_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel  # noqa: F401

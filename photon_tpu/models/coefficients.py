@@ -1,0 +1,37 @@
+"""Model coefficients.
+
+Parity target: reference photon-lib model/Coefficients.scala:31-49 —
+``Coefficients(means, variancesOption)`` with ``computeScore`` dot product.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import Features, SparseFeatures
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, features: Features) -> Array:
+        if isinstance(features, SparseFeatures):
+            return features.matvec(self.means)
+        return features @ self.means
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(jnp.zeros((dim,), dtype))

@@ -1,0 +1,108 @@
+"""GAME model: fixed-effect + random-effect submodels.
+
+Parity targets: reference photon-lib model/GameModel.scala:32-142 (scoring =
+sum over sub-model scores), photon-api model/FixedEffectModel.scala:33-113
+(broadcast GLM) and model/RandomEffectModel.scala:36-226 (RDD[(REId, GLM)],
+score via join).
+
+TPU-first design: a RandomEffectModel is ONE dense (E, d_shard) coefficient
+matrix sharded over the mesh's entity axis; scoring is a gather by each
+sample's entity index + a rowwise dot — the reference's model×data join is a
+single XLA gather. Missing entities (index -1) score 0, mirroring the
+reference's missing-submodel semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import SparseFeatures
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Global GLM over one feature shard (reference FixedEffectModel.scala).
+    In SPMD there is no broadcast step: w is replicated by sharding rule."""
+
+    model: GeneralizedLinearModel
+    feature_shard: str = dataclasses.field(metadata=dict(static=True))
+
+    def score(self, batch: GameBatch) -> Array:
+        """Raw per-sample scores x·w (no offset — offsets/residuals are
+        handled by the coordinate descent loop)."""
+        return self.model.compute_score(batch.features[self.feature_shard])
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity GLMs as one dense coefficient matrix.
+
+    coefficients: (E, d_shard); row e is entity e's model in the shard's
+    feature space. variances: optional (E, d_shard).
+    """
+
+    coefficients: Array
+    re_type: str = dataclasses.field(metadata=dict(static=True))
+    feature_shard: str = dataclasses.field(metadata=dict(static=True))
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+    variances: Optional[Array] = None
+
+    @property
+    def num_entities(self) -> int:
+        return self.coefficients.shape[0]
+
+    def score(self, batch: GameBatch) -> Array:
+        """Gather-by-entity scoring (replaces RandomEffectModel.scala's
+        keyBy(REId).join(modelsRDD))."""
+        idx = batch.entity_ids[self.re_type]
+        valid = idx >= 0
+        safe_idx = jnp.where(valid, idx, 0)
+        w = self.coefficients[safe_idx]  # (n, d)
+        feats = batch.features[self.feature_shard]
+        if isinstance(feats, SparseFeatures):
+            scores = jnp.sum(
+                feats.values * jnp.take_along_axis(w, feats.indices, axis=1), axis=-1
+            )
+        else:
+            scores = jnp.sum(feats * w, axis=-1)
+        return jnp.where(valid, scores, 0.0)
+
+
+DatumScoringModel = Union[FixedEffectModel, RandomEffectModel]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Map coordinate-id -> submodel; total score = Σ submodel scores
+    (GameModel.scoreForCoordinateDescent, reference GameModel.scala:102)."""
+
+    models: Dict[str, DatumScoringModel]
+
+    def score(self, batch: GameBatch) -> Array:
+        total = jnp.zeros((batch.n,), batch.offset.dtype)
+        for model in self.models.values():
+            total = total + model.score(batch)
+        return total
+
+    def score_with_offset(self, batch: GameBatch) -> Array:
+        return self.score(batch) + batch.offset
+
+    def get(self, coordinate_id: str) -> Optional[DatumScoringModel]:
+        return self.models.get(coordinate_id)
+
+    def updated(self, coordinate_id: str, model: DatumScoringModel) -> "GameModel":
+        new = dict(self.models)
+        new[coordinate_id] = model
+        return GameModel(new)

@@ -1,0 +1,59 @@
+"""Generalized linear models.
+
+Parity target: reference photon-api supervised/model/GeneralizedLinearModel
+.scala:33-156 and task wrappers (LogisticRegressionModel.scala:31,
+LinearRegressionModel, PoissonRegressionModel, SmoothedHinge...). One class
+parameterized by TaskType replaces the subclass-per-task hierarchy — the mean
+function comes from the task's PointwiseLoss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import Features, LabeledBatch
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    coefficients: Coefficients
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+
+    def compute_score(self, features: Features) -> Array:
+        """Raw margin x·w (GeneralizedLinearModel.computeScore,
+        reference :61)."""
+        return self.coefficients.compute_score(features)
+
+    def compute_scores(self, batch: LabeledBatch) -> Array:
+        """Margins including the batch offsets."""
+        return self.compute_score(batch.features) + batch.offset
+
+    def compute_mean(self, features: Features, offset: Optional[Array] = None) -> Array:
+        """E[y|x]: the task's inverse link applied to the margin
+        (computeMeanFunction in the reference subclasses)."""
+        z = self.compute_score(features)
+        if offset is not None:
+            z = z + offset
+        return loss_for_task(self.task).mean(z)
+
+    def predict_class(self, features: Features, threshold: float = 0.5) -> Array:
+        """Binary decision for classification tasks (BinaryClassifier role)."""
+        if self.task == TaskType.LOGISTIC_REGRESSION:
+            return (self.compute_mean(features) > threshold).astype(jnp.int32)
+        if self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+            return (self.compute_score(features) > 0).astype(jnp.int32)
+        raise ValueError(f"{self.task} is not a classification task")
+
+    @staticmethod
+    def zeros(dim: int, task: TaskType, dtype=jnp.float32) -> "GeneralizedLinearModel":
+        return GeneralizedLinearModel(Coefficients.zeros(dim, dtype), task)

@@ -1,0 +1,9 @@
+from photon_tpu.ops.losses import (  # noqa: F401
+    PointwiseLoss,
+    LogisticLoss,
+    SquaredLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    loss_for_task,
+)
+from photon_tpu.ops.objective import GLMObjective  # noqa: F401

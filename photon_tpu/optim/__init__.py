@@ -1,0 +1,5 @@
+from photon_tpu.optim.common import OptimizeResult, OptimizerConfig  # noqa: F401
+from photon_tpu.optim.lbfgs import minimize_lbfgs  # noqa: F401
+from photon_tpu.optim.owlqn import minimize_owlqn  # noqa: F401
+from photon_tpu.optim.tron import minimize_tron  # noqa: F401
+from photon_tpu.optim.factory import make_optimizer  # noqa: F401

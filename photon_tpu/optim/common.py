@@ -1,0 +1,139 @@
+"""Shared optimizer plumbing: result/state containers and convergence logic.
+
+Parity targets: ``Optimizer.optimize`` template loop + convergence reasons
+(reference photon-lib optimization/Optimizer.scala:126-187) and
+``OptimizationStatesTracker`` (OptimizationStatesTracker.scala:31-113).
+
+TPU-first design: the optimize loop is a single ``lax.while_loop`` inside one
+jitted program — per-iteration state (loss, gradient norm) is recorded into
+fixed-size history arrays (the tracker), so observability survives jit without
+host round-trips. Convergence reasons are int codes resolved to the
+``ConvergenceReason`` enum on the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.types import ConvergenceReason
+
+Array = jax.Array
+
+# Reason codes used inside jit (host maps them back to the enum).
+REASON_NOT_CONVERGED = 0
+REASON_MAX_ITERATIONS = 1
+REASON_FUNCTION_VALUES_CONVERGED = 2
+REASON_GRADIENT_CONVERGED = 3
+REASON_OBJECTIVE_NOT_IMPROVING = 4
+
+_REASONS = {
+    REASON_NOT_CONVERGED: ConvergenceReason.NOT_CONVERGED,
+    REASON_MAX_ITERATIONS: ConvergenceReason.MAX_ITERATIONS,
+    REASON_FUNCTION_VALUES_CONVERGED: ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+    REASON_GRADIENT_CONVERGED: ConvergenceReason.GRADIENT_CONVERGED,
+    REASON_OBJECTIVE_NOT_IMPROVING: ConvergenceReason.OBJECTIVE_NOT_IMPROVING,
+}
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Static solver configuration. Defaults mirror the reference:
+    L-BFGS maxIter=100, m=10, tol=1e-7 (LBFGS.scala:148-154);
+    TRON overrides maxIter=15, tol=1e-5 (TRON.scala:251-256)."""
+
+    max_iter: int = dataclasses.field(default=100, metadata=dict(static=True))
+    tol: float = dataclasses.field(default=1e-7, metadata=dict(static=True))
+    memory: int = dataclasses.field(default=10, metadata=dict(static=True))
+    # Line-search evaluation budget per iteration.
+    max_line_search_evals: int = dataclasses.field(default=20, metadata=dict(static=True))
+    # Record per-iteration (loss, |grad|) histories. Disable for vmapped
+    # per-entity solves where (E, max_iter) tracker arrays would dominate HBM
+    # (the reference's RandomEffectOptimizationTracker keeps only aggregate
+    # stats for the same reason).
+    track_history: bool = dataclasses.field(default=True, metadata=dict(static=True))
+
+    @property
+    def history_len(self) -> int:
+        return self.max_iter + 1 if self.track_history else 1
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class OptimizeResult:
+    """Solution + tracker (OptimizationStatesTracker role).
+
+    ``loss_history[i]`` / ``grad_norm_history[i]`` hold the state after i
+    iterations; entries past ``iterations`` are padded with the final values.
+    """
+
+    w: Array
+    value: Array
+    grad_norm: Array
+    iterations: Array
+    reason_code: Array
+    loss_history: Array
+    grad_norm_history: Array
+
+    @property
+    def converged(self) -> bool:
+        return int(self.reason_code) in (
+            REASON_FUNCTION_VALUES_CONVERGED,
+            REASON_GRADIENT_CONVERGED,
+        )
+
+    @property
+    def convergence_reason(self) -> ConvergenceReason:
+        return _REASONS[int(self.reason_code)]
+
+    def summary(self) -> str:
+        """Human-readable per-iteration table (tracker toSummaryString)."""
+        n = int(self.iterations)
+        lines = ["iter    loss           |grad|"]
+        for i in range(n + 1):
+            lines.append(
+                f"{i:4d}    {float(self.loss_history[i]):.6e}   "
+                f"{float(self.grad_norm_history[i]):.6e}"
+            )
+        lines.append(f"reason: {self.convergence_reason.value}")
+        return "\n".join(lines)
+
+
+def check_convergence(
+    value: Array,
+    prev_value: Array,
+    grad_norm: Array,
+    init_grad_norm: Array,
+    tol: float,
+    iteration: Array,
+    max_iter: int,
+) -> Array:
+    """Reason code for the current state (Optimizer.scala:126-139 semantics):
+    gradient converged relative to the initial gradient norm; function values
+    converged on relative improvement; max iterations."""
+    rel_impr = jnp.abs(value - prev_value) / jnp.maximum(jnp.abs(prev_value), 1e-12)
+    code = jnp.where(
+        grad_norm <= tol * jnp.maximum(init_grad_norm, 1e-12),
+        REASON_GRADIENT_CONVERGED,
+        jnp.where(
+            rel_impr <= tol,
+            REASON_FUNCTION_VALUES_CONVERGED,
+            jnp.where(iteration >= max_iter, REASON_MAX_ITERATIONS, REASON_NOT_CONVERGED),
+        ),
+    )
+    return code.astype(jnp.int32)
+
+
+def project_to_box(
+    w: Array, box: Optional[Tuple[Array, Array]]
+) -> Array:
+    """Coefficient box projection (reference
+    OptimizationUtils.projectCoefficientsToSubspace, OptimizationUtils.scala:56)."""
+    if box is None:
+        return w
+    lower, upper = box
+    return jnp.clip(w, lower, upper)

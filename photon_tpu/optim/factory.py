@@ -1,0 +1,76 @@
+"""Optimizer factory: config → solver closure over a GLMObjective.
+
+Parity target: reference photon-api optimization/OptimizerFactory +
+OptimizerConfig case classes; selection semantics from
+ObjectiveFunctionHelper/GeneralizedLinearOptimizationProblem: OWL-QN when an
+L1 weight is present, otherwise the configured solver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.common import OptimizeResult, OptimizerConfig
+from photon_tpu.optim.lbfgs import minimize_lbfgs, minimize_lbfgsb
+from photon_tpu.optim.owlqn import minimize_owlqn
+from photon_tpu.optim.tron import TRON_DEFAULT_CONFIG, minimize_tron
+from photon_tpu.types import OptimizerType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerSpec:
+    """User-facing optimizer configuration (reference
+    CoordinateOptimizationConfiguration optimizer fields)."""
+
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    max_iter: Optional[int] = None
+    tol: Optional[float] = None
+    memory: int = 10
+    max_cg_iter: int = 20
+    box: Optional[Tuple[Array, Array]] = None
+
+    def config(self) -> OptimizerConfig:
+        base = TRON_DEFAULT_CONFIG if self.optimizer == OptimizerType.TRON else OptimizerConfig()
+        return OptimizerConfig(
+            max_iter=self.max_iter if self.max_iter is not None else base.max_iter,
+            tol=self.tol if self.tol is not None else base.tol,
+            memory=self.memory,
+        )
+
+
+def make_optimizer(
+    objective: GLMObjective, spec: OptimizerSpec
+) -> Callable[[Array, object], OptimizeResult]:
+    """Return solve(w0, batch) -> OptimizeResult for the given objective.
+
+    OWL-QN is auto-selected when the objective carries an L1 weight
+    (reference RegularizationContext L1/elastic-net routing via OWLQN.scala).
+    """
+    config = spec.config()
+
+    def solve(w0: Array, batch) -> OptimizeResult:
+        vg = lambda w: objective.value_and_grad(w, batch)
+        if objective.l1_weight > 0.0:
+            l1_mask = None
+            if objective.intercept_index is not None:
+                import jax.numpy as jnp
+
+                l1_mask = jnp.ones_like(w0).at[objective.intercept_index].set(0.0)
+            return minimize_owlqn(vg, w0, objective.l1_weight, config, l1_mask)
+        if spec.optimizer == OptimizerType.TRON:
+            hvp = lambda w, v: objective.hvp(w, v, batch)
+            return minimize_tron(vg, hvp, w0, config, spec.max_cg_iter, spec.box)
+        if spec.optimizer == OptimizerType.LBFGSB:
+            assert spec.box is not None, "LBFGSB requires a box"
+            return minimize_lbfgsb(vg, w0, spec.box[0], spec.box[1], config)
+        if spec.optimizer == OptimizerType.OWLQN:
+            return minimize_owlqn(vg, w0, objective.l1_weight, config)
+        return minimize_lbfgs(vg, w0, config, spec.box)
+
+    return solve

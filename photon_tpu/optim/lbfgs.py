@@ -1,0 +1,254 @@
+"""L-BFGS (+ box-constrained variant), fully jittable.
+
+Parity targets: reference photon-lib optimization/LBFGS.scala:38-154 (which
+wraps breeze.optimize.LBFGS; defaults maxIter=100, m=10, tol=1e-7) and
+LBFGSB.scala:39-90 (box-constrained variant). The reference also applies
+per-iteration box projection of coefficients (OptimizationUtils.scala:56).
+
+TPU-first design: the optimizer is one ``lax.while_loop`` whose carried state
+holds the circular (m, d) curvature history — the entire optimize call
+(including every objective evaluation over the sharded batch) compiles to a
+single XLA program. With the batch sharded over the mesh's data axis, every
+gradient evaluation's cross-device psum is inserted by XLA; there are no
+per-iteration host round-trips (the reference pays one broadcast + one
+treeAggregate per iteration, ValueAndGradientAggregator.scala:300-321).
+
+Box constraints use projected line search (trial points are clipped to the
+box before evaluation), which subsumes the reference's per-iteration
+projection and is the standard projected-quasi-Newton approach on TPU-friendly
+static shapes (no active-set bookkeeping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.optim.common import (
+    OptimizeResult,
+    OptimizerConfig,
+    REASON_MAX_ITERATIONS,
+    REASON_NOT_CONVERGED,
+    check_convergence,
+)
+from photon_tpu.optim.linesearch import strong_wolfe
+
+Array = jax.Array
+ValueAndGrad = Callable[[Array], Tuple[Array, Array]]
+
+
+def two_loop_direction(
+    grad: Array, s_hist: Array, y_hist: Array, rho_hist: Array, num_stored: Array, head: Array
+) -> Array:
+    """Classic two-loop recursion over a circular history buffer.
+
+    s_hist/y_hist: (m, d); rho_hist: (m,). ``head`` points at the slot holding
+    the MOST RECENT pair; iteration runs newest→oldest then oldest→newest with
+    masking for unfilled slots (static shapes, no dynamic slicing).
+    """
+    m = s_hist.shape[0]
+
+    def newest_to_oldest(i, carry):
+        q, alphas = carry
+        slot = (head - i) % m
+        valid = i < num_stored
+        alpha = rho_hist[slot] * jnp.dot(s_hist[slot], q)
+        alpha = jnp.where(valid, alpha, 0.0)
+        q = q - alpha * y_hist[slot]
+        return q, alphas.at[slot].set(alpha)
+
+    q, alphas = jax.lax.fori_loop(
+        0, m, newest_to_oldest, (grad, jnp.zeros((m,), grad.dtype))
+    )
+
+    # Initial Hessian scaling gamma = s·y / y·y from the most recent pair.
+    recent = head % m
+    sy = jnp.dot(s_hist[recent], y_hist[recent])
+    yy = jnp.dot(y_hist[recent], y_hist[recent])
+    gamma = jnp.where(
+        (num_stored > 0) & (yy > 0), sy / jnp.maximum(yy, 1e-30), 1.0
+    )
+    r = gamma * q
+
+    def oldest_to_newest(i, r):
+        slot = (head - (num_stored - 1 - i)) % m
+        valid = i < num_stored
+        beta = rho_hist[slot] * jnp.dot(y_hist[slot], r)
+        upd = (alphas[slot] - beta) * s_hist[slot]
+        return r + jnp.where(valid, 1.0, 0.0) * upd
+
+    r = jax.lax.fori_loop(0, m, oldest_to_newest, r)
+    return -r
+
+
+def minimize_lbfgs(
+    value_and_grad: ValueAndGrad,
+    w0: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    box: Optional[Tuple[Array, Array]] = None,
+) -> OptimizeResult:
+    """Minimize a smooth function with L-BFGS (optionally box-constrained).
+
+    Args:
+      value_and_grad: w -> (f, ∇f). Jittable; typically GLMObjective.value_and_grad
+        closed over a (possibly mesh-sharded) batch.
+      w0: initial point (projected into the box if one is given).
+      box: optional (lower, upper) arrays broadcastable to w's shape.
+    """
+    m, max_iter, tol = config.memory, config.max_iter, config.tol
+    d = w0.shape[0]
+    dtype = w0.dtype
+
+    def proj(w):
+        if box is None:
+            return w
+        return jnp.clip(w, box[0], box[1])
+
+    def opt_gnorm(w, g):
+        # Convergence measure: plain gradient norm, or the projected-gradient
+        # norm ‖w − proj(w − g)‖ under box constraints (0 at a KKT point).
+        if box is None:
+            return jnp.linalg.norm(g)
+        return jnp.linalg.norm(w - proj(w - g))
+
+    w0 = proj(w0)
+    f0, g0 = value_and_grad(w0)
+    g0_norm = opt_gnorm(w0, g0)
+
+    hist_len = config.history_len
+    loss_hist0 = jnp.full((hist_len,), f0, dtype)
+    gnorm_hist0 = jnp.full((hist_len,), g0_norm, dtype)
+
+    state0 = dict(
+        w=w0,
+        f=f0,
+        g=g0,
+        it=jnp.int32(0),
+        reason=jnp.int32(REASON_NOT_CONVERGED),
+        s_hist=jnp.zeros((m, d), dtype),
+        y_hist=jnp.zeros((m, d), dtype),
+        rho_hist=jnp.zeros((m,), dtype),
+        num_stored=jnp.int32(0),
+        head=jnp.int32(0),
+        loss_hist=loss_hist0,
+        gnorm_hist=gnorm_hist0,
+    )
+
+    def cond(st):
+        return (st["reason"] == REASON_NOT_CONVERGED) & (st["it"] < max_iter)
+
+    def body(st):
+        w, f, g = st["w"], st["f"], st["g"]
+        if box is None:
+            g_dir = g
+        else:
+            # Gradient-projection active set: freeze coordinates sitting on a
+            # bound with the gradient pushing outward, so the quasi-Newton
+            # direction moves only in the free subspace.
+            eps = 1e-9
+            active = ((w <= box[0] + eps) & (g > 0)) | ((w >= box[1] - eps) & (g < 0))
+            g_dir = jnp.where(active, 0.0, g)
+        p = two_loop_direction(
+            g_dir, st["s_hist"], st["y_hist"], st["rho_hist"], st["num_stored"], st["head"]
+        )
+        if box is not None:
+            p = jnp.where(((w <= box[0] + 1e-9) & (g > 0)) | ((w >= box[1] - 1e-9) & (g < 0)), 0.0, p)
+        dg0 = jnp.dot(p, g)
+        # Safeguard: fall back to (projected) steepest descent on a
+        # non-descent direction.
+        bad_dir = dg0 >= 0
+        p = jnp.where(bad_dir, -g_dir, p)
+        dg0 = jnp.where(bad_dir, -jnp.dot(g_dir, g_dir), dg0)
+
+        if box is None:
+            fg_alpha = lambda a: value_and_grad(w + a * p)
+            ls_fg = lambda a: _with_dir_deriv(fg_alpha(a), p)
+        else:
+            def ls_fg(a):
+                wt = proj(w + a * p)
+                ft, gt = value_and_grad(wt)
+                # Derivative along the *projected* path direction.
+                return ft, jnp.dot(gt, (wt - w) / jnp.maximum(a, 1e-30))
+
+        init_alpha = jnp.where(st["num_stored"] == 0, jnp.minimum(1.0, 1.0 / jnp.maximum(jnp.linalg.norm(g), 1e-12)), 1.0)
+        ls = strong_wolfe(
+            ls_fg, f, dg0, init_alpha.astype(dtype),
+            max_evals=config.max_line_search_evals,
+        )
+
+        w_new = proj(w + ls.alpha * p)
+        f_new, g_new = value_and_grad(w_new)
+
+        s = w_new - w
+        y = g_new - g
+        sy = jnp.dot(s, y)
+        # Curvature condition: only store pairs with s·y > eps (keeps H ≻ 0).
+        store = sy > 1e-12
+        slot = (st["head"] + 1) % m
+        s_hist = jnp.where(store, st["s_hist"].at[slot].set(s), st["s_hist"])
+        y_hist = jnp.where(store, st["y_hist"].at[slot].set(y), st["y_hist"])
+        rho_hist = jnp.where(
+            store, st["rho_hist"].at[slot].set(1.0 / jnp.maximum(sy, 1e-30)), st["rho_hist"]
+        )
+        head = jnp.where(store, slot, st["head"])
+        num_stored = jnp.where(store, jnp.minimum(st["num_stored"] + 1, m), st["num_stored"])
+
+        it = st["it"] + 1
+        gn = opt_gnorm(w_new, g_new)
+        reason = check_convergence(f_new, f, gn, g0_norm, tol, it, max_iter)
+        # A step that made no progress at all terminates the loop
+        # (OBJECTIVE_NOT_IMPROVING analogue handled by fn-values check since
+        # |Δf|=0 ⇒ FUNCTION_VALUES_CONVERGED).
+        return dict(
+            w=w_new,
+            f=f_new,
+            g=g_new,
+            it=it,
+            reason=reason,
+            s_hist=s_hist,
+            y_hist=y_hist,
+            rho_hist=rho_hist,
+            num_stored=num_stored,
+            head=head,
+            loss_hist=st["loss_hist"].at[jnp.minimum(it, config.history_len - 1)].set(f_new),
+            gnorm_hist=st["gnorm_hist"].at[jnp.minimum(it, config.history_len - 1)].set(gn),
+        )
+
+    st = jax.lax.while_loop(cond, body, state0)
+    # Pad histories past the last iteration with the final values.
+    idx = jnp.arange(config.history_len)
+    loss_hist = jnp.where(idx <= st["it"], st["loss_hist"], st["f"])
+    gnorm_hist = jnp.where(idx <= st["it"], st["gnorm_hist"], jnp.linalg.norm(st["g"]))
+    reason = jnp.where(
+        st["reason"] == REASON_NOT_CONVERGED, REASON_MAX_ITERATIONS, st["reason"]
+    )
+    return OptimizeResult(
+        w=st["w"],
+        value=st["f"],
+        grad_norm=jnp.linalg.norm(st["g"]),
+        iterations=st["it"],
+        reason_code=reason,
+        loss_history=loss_hist,
+        grad_norm_history=gnorm_hist,
+    )
+
+
+def _with_dir_deriv(fg: Tuple[Array, Array], p: Array) -> Tuple[Array, Array]:
+    f, g = fg
+    return f, jnp.dot(g, p)
+
+
+def minimize_lbfgsb(
+    value_and_grad: ValueAndGrad,
+    w0: Array,
+    lower: Array,
+    upper: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+) -> OptimizeResult:
+    """Box-constrained L-BFGS (reference LBFGSB.scala:39-90 capability,
+    implemented as projected-line-search L-BFGS rather than the full Byrd
+    subspace algorithm — same constraint semantics, TPU-static shapes)."""
+    return minimize_lbfgs(value_and_grad, w0, config, box=(lower, upper))

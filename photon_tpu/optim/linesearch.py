@@ -1,0 +1,180 @@
+"""Strong-Wolfe line search, fully jittable.
+
+Role parity: the reference delegates line search to Breeze's
+``StrongWolfeLineSearch`` inside breeze.optimize.LBFGS (used via
+photon-lib optimization/LBFGS.scala:38-79). Here it is a single
+``lax.while_loop`` state machine (bracket phase + zoom phase, one objective
+evaluation per loop step — evaluations are full passes over the sharded batch,
+so evaluation count is the cost model). Interpolation is safeguarded
+quadratic; termination and fallbacks follow Nocedal & Wright alg. 3.5/3.6.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_BRACKET = 0
+_ZOOM = 1
+_DONE = 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LineSearchResult:
+    alpha: Array
+    value: Array
+    deriv: Array  # directional derivative at alpha
+    evals: Array
+    success: Array  # strong Wolfe conditions met
+
+
+def _interp(a_lo, f_lo, g_lo, a_hi, f_hi):
+    """Safeguarded quadratic interpolation for the zoom trial point."""
+    d = a_hi - a_lo
+    denom = f_hi - f_lo - g_lo * d
+    a_q = a_lo - 0.5 * g_lo * d * d / jnp.where(jnp.abs(denom) > 1e-20, denom, 1.0)
+    # Keep the trial strictly inside [lo, hi] with a 10% margin; fall back to
+    # bisection when interpolation misbehaves.
+    lo = jnp.minimum(a_lo, a_hi)
+    hi = jnp.maximum(a_lo, a_hi)
+    margin = 0.1 * (hi - lo)
+    bad = (
+        jnp.isnan(a_q)
+        | (jnp.abs(denom) <= 1e-20)
+        | (a_q < lo + margin)
+        | (a_q > hi - margin)
+    )
+    return jnp.where(bad, 0.5 * (a_lo + a_hi), a_q)
+
+
+def strong_wolfe(
+    fg: Callable[[Array], Tuple[Array, Array]],
+    f0: Array,
+    dg0: Array,
+    init_alpha: Array,
+    c1: float = 1e-4,
+    c2: float = 0.9,
+    max_evals: int = 20,
+    max_alpha: float = 1e10,
+) -> LineSearchResult:
+    """Find alpha satisfying f(a) <= f0 + c1*a*dg0 and |f'(a)| <= c2*|dg0|.
+
+    Args:
+      fg: alpha -> (f(x + alpha*p), p·∇f(x + alpha*p)). Must be jittable.
+      f0, dg0: value and directional derivative at alpha=0 (dg0 < 0 required).
+      init_alpha: first trial step.
+
+    On budget exhaustion returns the best sufficient-decrease point seen
+    (practical fallback; keeps L-BFGS making progress on ill-scaled problems).
+    """
+    dtype = jnp.asarray(f0).dtype
+    zero = jnp.zeros((), dtype)
+
+    # state: (phase, a_prev, f_prev, g_prev, a_lo, f_lo, g_lo, a_hi, f_hi,
+    #         a_cur, evals, a_best, f_best, g_best, success)
+    state0 = (
+        jnp.int32(_BRACKET),
+        zero, f0, dg0,  # prev
+        zero, f0, dg0,  # lo
+        zero, f0,       # hi (f only; g_hi unused by quad interp)
+        jnp.asarray(init_alpha, dtype),
+        jnp.int32(0),
+        zero, f0, dg0,  # best sufficient-decrease point
+        jnp.bool_(False),
+    )
+
+    suff = lambda a, f: f <= f0 + c1 * a * dg0
+    curv = lambda g: jnp.abs(g) <= -c2 * dg0
+
+    def cond(state):
+        phase, evals = state[0], state[10]
+        return (phase != _DONE) & (evals < max_evals)
+
+    def body(state):
+        (phase, a_prev, f_prev, g_prev, a_lo, f_lo, g_lo, a_hi, f_hi,
+         a_cur, evals, a_best, f_best, g_best, success) = state
+
+        f, g = fg(a_cur)
+        evals = evals + 1
+
+        ok = suff(a_cur, f)
+        better = ok & (f < f_best)
+        a_best = jnp.where(better, a_cur, a_best)
+        f_best = jnp.where(better, f, f_best)
+        g_best = jnp.where(better, g, g_best)
+
+        def bracket_step():
+            fail = (~ok) | ((evals > 1) & (f >= f_prev))
+            wolfe = ok & curv(g)
+            rising = ok & (g >= 0)
+            # zoom(lo=prev, hi=cur) on failure; zoom(lo=cur, hi=prev) on rise.
+            z_lo_a = jnp.where(fail, a_prev, a_cur)
+            z_lo_f = jnp.where(fail, f_prev, f)
+            z_lo_g = jnp.where(fail, g_prev, g)
+            z_hi_a = jnp.where(fail, a_cur, a_prev)
+            z_hi_f = jnp.where(fail, f, f_prev)
+            to_zoom = fail | rising
+            nphase = jnp.where(wolfe, _DONE, jnp.where(to_zoom, _ZOOM, _BRACKET)).astype(jnp.int32)
+            trial = jnp.where(
+                to_zoom,
+                _interp(z_lo_a, z_lo_f, z_lo_g, z_hi_a, z_hi_f),
+                jnp.minimum(2.0 * a_cur, max_alpha),
+            )
+            return (
+                nphase,
+                a_cur, f, g,          # prev ← cur
+                z_lo_a, z_lo_f, z_lo_g,
+                z_hi_a, z_hi_f,
+                trial,
+                evals,
+                jnp.where(wolfe, a_cur, a_best),
+                jnp.where(wolfe, f, f_best),
+                jnp.where(wolfe, g, g_best),
+                success | wolfe,
+            )
+
+        def zoom_step():
+            fail = (~ok) | (f >= f_lo)
+            wolfe = (~fail) & curv(g)
+            # On fail: hi ← cur. Else lo ← cur (and hi ← old lo if the slope
+            # says the minimum is on the other side).
+            flip = (~fail) & (g * (a_hi - a_lo) >= 0)
+            n_hi_a = jnp.where(fail, a_cur, jnp.where(flip, a_lo, a_hi))
+            n_hi_f = jnp.where(fail, f, jnp.where(flip, f_lo, f_hi))
+            n_lo_a = jnp.where(fail, a_lo, a_cur)
+            n_lo_f = jnp.where(fail, f_lo, f)
+            n_lo_g = jnp.where(fail, g_lo, g)
+            interval_dead = jnp.abs(n_hi_a - n_lo_a) <= 1e-12 * jnp.maximum(1.0, n_hi_a)
+            nphase = jnp.where(wolfe | interval_dead, _DONE, _ZOOM).astype(jnp.int32)
+            trial = _interp(n_lo_a, n_lo_f, n_lo_g, n_hi_a, n_hi_f)
+            return (
+                nphase,
+                a_cur, f, g,
+                n_lo_a, n_lo_f, n_lo_g,
+                n_hi_a, n_hi_f,
+                trial,
+                evals,
+                jnp.where(wolfe, a_cur, a_best),
+                jnp.where(wolfe, f, f_best),
+                jnp.where(wolfe, g, g_best),
+                success | wolfe,
+            )
+
+        return jax.lax.cond(phase == _BRACKET, bracket_step, zoom_step)
+
+    final = jax.lax.while_loop(cond, body, state0)
+    (_, _, _, _, a_lo, f_lo, g_lo, _, _, _, evals, a_best, f_best, g_best, success) = final
+
+    # Fallback: best Wolfe point if found, else best sufficient-decrease point,
+    # else the zoom lo endpoint (never worse than f0 by construction).
+    have_best = f_best < f0
+    alpha = jnp.where(success | have_best, a_best, a_lo)
+    value = jnp.where(success | have_best, f_best, f_lo)
+    deriv = jnp.where(success | have_best, g_best, g_lo)
+    return LineSearchResult(alpha=alpha, value=value, deriv=deriv, evals=evals, success=success)

@@ -1,0 +1,2 @@
+from photon_tpu.parallel.mesh import make_mesh, DATA_AXIS, ENTITY_AXIS, FEATURE_AXIS  # noqa: F401
+from photon_tpu.parallel.distributed import shard_batch, replicate  # noqa: F401

@@ -1,0 +1,92 @@
+"""Sharded data placement — the framework's "communication backend".
+
+Role parity: reference §2.8 — Spark treeAggregate/broadcast/shuffle. Here the
+entire backend is: place batches on the mesh with NamedShardings and jit the
+objective/optimizer over them; XLA inserts the psum/all-gather collectives.
+There is no aggregator code to maintain — ``GLMObjective``'s sums become
+cross-device reductions purely by virtue of input sharding (the compiled
+program is the SPMD equivalent of broadcast(w) + treeAggregate(add, merge),
+reference ValueAndGradientAggregator.scala:300-321).
+
+``shard_batch`` pads the batch to a device-divisible size with weight-0 rows
+(weighted sums make padding exact, see LabeledBatch), so ragged inputs never
+produce dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+from photon_tpu.parallel.mesh import DATA_AXIS
+
+
+def _pad_rows(a: jax.Array, target: int, fill=0):
+    n = a.shape[0]
+    if n == target:
+        return a
+    pad_width = [(0, target - n)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, pad_width, constant_values=fill)
+
+
+def pad_batch(batch: LabeledBatch, target_n: int) -> LabeledBatch:
+    """Pad to ``target_n`` rows with weight-0 padding samples."""
+    if batch.n == target_n:
+        return batch
+    assert target_n > batch.n
+    feats = batch.features
+    if isinstance(feats, SparseFeatures):
+        feats = SparseFeatures(
+            _pad_rows(feats.indices, target_n), _pad_rows(feats.values, target_n), feats.dim
+        )
+    else:
+        feats = _pad_rows(feats, target_n)
+    return LabeledBatch(
+        label=_pad_rows(batch.label, target_n),
+        features=feats,
+        offset=_pad_rows(batch.offset, target_n),
+        weight=_pad_rows(batch.weight, target_n),  # 0-weight padding
+        uid=None if batch.uid is None else _pad_rows(batch.uid, target_n, fill=-1),
+    )
+
+
+def shard_batch(batch: LabeledBatch, mesh: Mesh) -> LabeledBatch:
+    """Pad to a data-axis-divisible size and place on the mesh, samples
+    sharded over DATA_AXIS, feature dim replicated."""
+    n_shards = mesh.shape[DATA_AXIS]
+    target = int(np.ceil(batch.n / n_shards) * n_shards)
+    batch = pad_batch(batch, target)
+
+    vec = NamedSharding(mesh, P(DATA_AXIS))
+    mat = NamedSharding(mesh, P(DATA_AXIS, None))
+
+    def place(x, sh):
+        return jax.device_put(x, sh)
+
+    feats = batch.features
+    if isinstance(feats, SparseFeatures):
+        feats = SparseFeatures(
+            place(feats.indices, mat), place(feats.values, mat), feats.dim
+        )
+    else:
+        feats = place(feats, mat)
+    return LabeledBatch(
+        label=place(batch.label, vec),
+        features=feats,
+        offset=place(batch.offset, vec),
+        weight=place(batch.weight, vec),
+        uid=None if batch.uid is None else place(batch.uid, vec),
+    )
+
+
+def replicate(x, mesh: Mesh):
+    """Replicate a pytree across the mesh (broadcast role — one-time
+    placement, not per-iteration: inside the jitted optimizer loop the
+    replicated w never leaves the devices)."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), x)

@@ -1,0 +1,67 @@
+"""Device mesh construction and axis conventions.
+
+Role parity: the reference's "cluster topology" is implicit in Spark
+(executors + treeAggregate depth, SURVEY.md §2.8). Here topology is explicit:
+a ``jax.sharding.Mesh`` whose axes name the framework's parallelism styles
+(SURVEY.md §2.7 mapping):
+
+- ``data``    — sample sharding; gradient reductions ride ICI psums
+                (replaces broadcast + treeAggregate).
+- ``entity``  — random-effect entity sharding (replaces the bin-packing
+                RDD partitioner, RandomEffectDatasetPartitioner.scala:44-96).
+- ``feature`` — feature-dimension sharding of w/gradient for coordinates too
+                large for one chip's HBM (the TP analogue; reference handles
+                this with sparse vectors + off-heap index maps).
+
+A mesh is usually 1-D ``(data,)`` or 2-D ``(data, feature)``; the entity axis
+aliases the data axis for GLMix jobs (fixed-effect batches and random-effect
+entity blocks are both sharded over the same physical devices).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "data"  # entities shard over the same physical axis as samples
+FEATURE_AXIS = "feature"
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_feature: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, feature) mesh over the available devices.
+
+    With ``n_feature == 1`` the mesh is effectively 1-D data-parallel; feature
+    sharding multiplies in for very wide coordinates.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devs) // n_feature
+    assert n_data * n_feature <= len(devs), (
+        f"mesh {n_data}x{n_feature} needs more than {len(devs)} devices"
+    )
+    grid = np.asarray(devs[: n_data * n_feature]).reshape(n_data, n_feature)
+    return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Per-sample arrays: sharded on the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """(n, k) per-sample matrices (features/indices): row-sharded."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+def feature_sharding(mesh: Mesh) -> NamedSharding:
+    """Coefficient vectors sharded on the feature axis (wide coordinates)."""
+    return NamedSharding(mesh, P(FEATURE_AXIS))

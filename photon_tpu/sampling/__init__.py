@@ -1,0 +1,6 @@
+from photon_tpu.sampling.down_sampler import (  # noqa: F401
+    BinaryClassificationDownSampler,
+    DefaultDownSampler,
+    DownSampler,
+    down_sampler_for_task,
+)

@@ -1,0 +1,57 @@
+"""Core enums and type aliases.
+
+Parity targets: TaskType (reference photon-lib TaskType.scala), type aliases
+(reference photon-lib Types.scala:15-45), ConvergenceReason (reference
+photon-lib util/ConvergenceReason.scala), NormalizationType (reference
+photon-lib normalization/NormalizationType.scala:20).
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Type aliases (reference Types.scala): UniqueSampleId = Long, CoordinateId /
+# REType / REId / FeatureShardId = String. In the TPU design, sample ids and
+# entity ids are int64 array indices — alignment by construction replaces joins.
+UniqueSampleId = int
+CoordinateId = str
+FeatureShardId = str
+REType = str
+
+
+class TaskType(enum.Enum):
+    """Supported GLM training tasks (reference TaskType.scala)."""
+
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+
+class ConvergenceReason(enum.Enum):
+    """Why an optimizer stopped (reference util/ConvergenceReason.scala,
+    Optimizer.getConvergenceReason Optimizer.scala:126-139)."""
+
+    MAX_ITERATIONS = "MAX_ITERATIONS"
+    FUNCTION_VALUES_CONVERGED = "FUNCTION_VALUES_CONVERGED"
+    GRADIENT_CONVERGED = "GRADIENT_CONVERGED"
+    OBJECTIVE_NOT_IMPROVING = "OBJECTIVE_NOT_IMPROVING"
+    NOT_CONVERGED = "NOT_CONVERGED"
+
+
+class NormalizationType(enum.Enum):
+    """Feature normalization schemes (reference NormalizationType.scala:20)."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+class OptimizerType(enum.Enum):
+    """Optimizer selection (reference OptimizerType / OptimizerFactory)."""
+
+    LBFGS = "LBFGS"
+    OWLQN = "OWLQN"
+    LBFGSB = "LBFGSB"
+    TRON = "TRON"

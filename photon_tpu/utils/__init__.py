@@ -1,0 +1,2 @@
+from photon_tpu.utils.timed import Timed  # noqa: F401
+from photon_tpu.utils.events import EventEmitter, Event  # noqa: F401

@@ -1,0 +1,74 @@
+"""Lifecycle event bus.
+
+Parity target: reference ``EventEmitter`` trait + listener registry
+(photon-client event/EventEmitter.scala:24-80) and the event types
+(event/Event.scala:28-70: PhotonSetupEvent, TrainingStartEvent,
+TrainingFinishEvent, PhotonOptimizationLogEvent). Listeners can be
+registered by dotted class path, mirroring the reference's
+class-name-from-CLI registration (Driver.scala:99-108).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    name: str
+    payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def setup_event(**kw) -> Event:
+    return Event("PhotonSetupEvent", kw)
+
+
+def training_start_event(**kw) -> Event:
+    return Event("TrainingStartEvent", kw)
+
+
+def training_finish_event(**kw) -> Event:
+    return Event("TrainingFinishEvent", kw)
+
+
+def optimization_log_event(**kw) -> Event:
+    return Event("PhotonOptimizationLogEvent", kw)
+
+
+Listener = Callable[[Event], None]
+
+
+class EventEmitter:
+    """Thread-safe listener registry + emit."""
+
+    def __init__(self):
+        self._listeners: List[Listener] = []
+        self._lock = threading.Lock()
+
+    def register(self, listener: Listener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    def register_by_name(self, dotted_path: str) -> None:
+        """Register a listener class/function by module path
+        ('pkg.module:attr' or 'pkg.module.attr')."""
+        if ":" in dotted_path:
+            mod, attr = dotted_path.split(":", 1)
+        else:
+            mod, _, attr = dotted_path.rpartition(".")
+        obj = getattr(importlib.import_module(mod), attr)
+        listener = obj() if isinstance(obj, type) else obj
+        self.register(listener)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._listeners.clear()
+
+    def emit(self, event: Event) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for l in listeners:
+            l(event)

@@ -1,0 +1,39 @@
+"""Phase timing.
+
+Parity target: reference ``Timed`` block timer (photon-lib util/Timed.scala,
+used around every driver phase, e.g. estimators/GameEstimator.scala:341-364).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+logger = logging.getLogger("photon_tpu")
+
+
+class Timed:
+    """Context-manager timer that logs and records wall time per phase."""
+
+    records: Dict[str, float] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Timed":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.monotonic() - self._t0
+        Timed.records[self.name] = self.elapsed
+        logger.info("[timed] %s: %.3fs", self.name, self.elapsed)
+
+
+@contextmanager
+def timed(name: str) -> Iterator[None]:
+    with Timed(name):
+        yield

@@ -1,0 +1,135 @@
+"""Evaluator golden-value tests vs naive numpy implementations.
+
+Mirrors the reference's golden-value evaluator tests
+(AreaUnderROCCurveLocalEvaluatorTest hand-computed AUC checks).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.evaluation.evaluators import (
+    EvaluatorType,
+    auc_pr,
+    auc_roc,
+    grouped_auc,
+    grouped_precision_at_k,
+    metric_is_better,
+    precision_at_k,
+    rmse,
+)
+
+rng = np.random.default_rng(3)
+
+
+def naive_weighted_auc(scores, labels, w):
+    """O(n²) probability a random positive outranks a random negative."""
+    num = den = 0.0
+    for i in range(len(scores)):
+        if labels[i] <= 0:
+            continue
+        for j in range(len(scores)):
+            if labels[j] > 0:
+                continue
+            wij = w[i] * w[j]
+            den += wij
+            if scores[i] > scores[j]:
+                num += wij
+            elif scores[i] == scores[j]:
+                num += 0.5 * wij
+    return num / den
+
+
+def test_auc_golden_small():
+    scores = jnp.array([0.1, 0.4, 0.35, 0.8])
+    labels = jnp.array([0.0, 0.0, 1.0, 1.0])
+    # ranks: pos 0.35 beats neg 0.1, loses to 0.4 → 1; pos 0.8 beats both → 2;
+    # AUC = 3/4
+    np.testing.assert_allclose(float(auc_roc(scores, labels)), 0.75, rtol=1e-6)
+
+
+def test_auc_with_ties_and_weights():
+    n = 101
+    scores = np.round(rng.normal(size=n), 1)  # heavy ties
+    labels = (rng.uniform(size=n) < 0.4).astype(float)
+    w = rng.uniform(0.1, 3.0, size=n)
+    expected = naive_weighted_auc(scores, labels, w)
+    got = float(auc_roc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(w)))
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_auc_perfect_and_random():
+    scores = jnp.array([1.0, 2.0, 3.0, 4.0])
+    labels = jnp.array([0.0, 0.0, 1.0, 1.0])
+    assert float(auc_roc(scores, labels)) == 1.0
+    assert float(auc_roc(-scores, labels)) == 0.0
+
+
+def test_auc_pr_reasonable():
+    n = 200
+    scores = rng.normal(size=n)
+    labels = (rng.uniform(size=n) < 1 / (1 + np.exp(-2 * scores))).astype(float)
+    v = float(auc_pr(jnp.asarray(scores), jnp.asarray(labels)))
+    base_rate = labels.mean()
+    assert base_rate < v <= 1.0  # must beat the random-classifier baseline
+
+
+def test_rmse_golden():
+    s = jnp.array([1.0, 2.0, 3.0])
+    y = jnp.array([1.0, 0.0, 3.0])
+    np.testing.assert_allclose(float(rmse(s, y)), np.sqrt(4.0 / 3.0), rtol=1e-6)
+
+
+def test_precision_at_k():
+    scores = jnp.array([0.9, 0.8, 0.7, 0.1])
+    labels = jnp.array([1.0, 0.0, 1.0, 1.0])
+    np.testing.assert_allclose(float(precision_at_k(scores, labels, 2)), 0.5)
+    np.testing.assert_allclose(float(precision_at_k(scores, labels, 3)), 2 / 3, rtol=1e-6)
+
+
+def test_grouped_auc_matches_per_group_naive():
+    n, G = 300, 7
+    scores = np.round(rng.normal(size=n), 1)
+    labels = (rng.uniform(size=n) < 0.5).astype(float)
+    w = rng.uniform(0.5, 2.0, size=n)
+    gids = rng.integers(0, G, size=n)
+    per_group = []
+    for g in range(G):
+        m = gids == g
+        if labels[m].sum() > 0 and (1 - labels[m]).sum() > 0:
+            per_group.append(naive_weighted_auc(scores[m], labels[m], w[m]))
+    expected = np.mean(per_group)
+    got = float(
+        grouped_auc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(gids), G, jnp.asarray(w))
+    )
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_grouped_auc_ignores_negative_group_ids():
+    """Cold-start samples (group id -1) must not perturb real groups'
+    AUC (regression: their negatives previously leaked into group 0)."""
+    scores = np.array([0.9, 0.1, 0.8, 0.3, 0.99, 0.01])
+    labels = np.array([1.0, 0.0, 1.0, 0.0, 1.0, 0.0])
+    gids_clean = np.array([0, 0, 1, 1, 0, 1])
+    base = float(grouped_auc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(gids_clean), 2))
+    # Append cold-start junk with id -1
+    scores2 = np.concatenate([scores, [0.5, 0.6, 0.7]])
+    labels2 = np.concatenate([labels, [0.0, 1.0, 0.0]])
+    gids2 = np.concatenate([gids_clean, [-1, -1, -1]])
+    got = float(grouped_auc(jnp.asarray(scores2), jnp.asarray(labels2), jnp.asarray(gids2), 2))
+    np.testing.assert_allclose(got, base, rtol=1e-6)
+
+
+def test_grouped_precision_at_k():
+    scores = jnp.array([0.9, 0.8, 0.1, 0.95, 0.2, 0.3])
+    labels = jnp.array([1.0, 0.0, 1.0, 0.0, 1.0, 1.0])
+    gids = jnp.array([0, 0, 0, 1, 1, 1])
+    # group 0 top-2: [0.9(+), 0.8(-)] → 0.5 ; group 1 top-2: [0.95(-), 0.3(+)] → 0.5
+    np.testing.assert_allclose(
+        float(grouped_precision_at_k(scores, labels, gids, 2, 2)), 0.5, rtol=1e-6
+    )
+
+
+def test_metric_direction():
+    assert metric_is_better(EvaluatorType.AUC)(0.9, 0.8)
+    assert not metric_is_better(EvaluatorType.AUC)(0.7, 0.8)
+    assert metric_is_better(EvaluatorType.RMSE)(0.1, 0.2)
