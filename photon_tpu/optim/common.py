@@ -78,6 +78,9 @@ class OptimizeResult:
     reason_code: Array
     loss_history: Array
     grad_norm_history: Array
+    # Total objective (value+grad) evaluations, including line-search trials —
+    # the cost unit for throughput accounting (each eval is one full data pass).
+    evals: Array = dataclasses.field(default_factory=lambda: jnp.zeros((), jnp.int32))
 
     @property
     def converged(self) -> bool:
@@ -93,6 +96,13 @@ class OptimizeResult:
     def summary(self) -> str:
         """Human-readable per-iteration table (tracker toSummaryString)."""
         n = int(self.iterations)
+        if self.loss_history.shape[0] < n + 1:
+            # track_history=False run: only aggregates are available.
+            return (
+                f"iterations={n} value={float(self.value):.6e} "
+                f"|grad|={float(self.grad_norm):.6e} "
+                f"reason: {self.convergence_reason.value} (history not tracked)"
+            )
         lines = ["iter    loss           |grad|"]
         for i in range(n + 1):
             lines.append(

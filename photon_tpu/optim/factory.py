@@ -56,6 +56,10 @@ def make_optimizer(
 
     def solve(w0: Array, batch) -> OptimizeResult:
         vg = lambda w: objective.value_and_grad(w, batch)
+        # OWL-QN whenever an L1 term exists (auto-selected or explicit) —
+        # with l1_weight == 0 OWL-QN degenerates below plain L-BFGS (orthant
+        # projection still pins sign-crossing coordinates), so a smooth
+        # objective always routes to L-BFGS regardless of the spec.
         if objective.l1_weight > 0.0:
             l1_mask = None
             if objective.intercept_index is not None:
@@ -69,8 +73,6 @@ def make_optimizer(
         if spec.optimizer == OptimizerType.LBFGSB:
             assert spec.box is not None, "LBFGSB requires a box"
             return minimize_lbfgsb(vg, w0, spec.box[0], spec.box[1], config)
-        if spec.optimizer == OptimizerType.OWLQN:
-            return minimize_owlqn(vg, w0, objective.l1_weight, config)
         return minimize_lbfgs(vg, w0, config, spec.box)
 
     return solve

@@ -133,6 +133,7 @@ def minimize_lbfgs(
         rho_hist=jnp.zeros((m,), dtype),
         num_stored=jnp.int32(0),
         head=jnp.int32(0),
+        evals=jnp.int32(1),
         loss_hist=loss_hist0,
         gnorm_hist=gnorm_hist0,
     )
@@ -155,7 +156,7 @@ def minimize_lbfgs(
             g_dir, st["s_hist"], st["y_hist"], st["rho_hist"], st["num_stored"], st["head"]
         )
         if box is not None:
-            p = jnp.where(((w <= box[0] + 1e-9) & (g > 0)) | ((w >= box[1] - 1e-9) & (g < 0)), 0.0, p)
+            p = jnp.where(active, 0.0, p)
         dg0 = jnp.dot(p, g)
         # Safeguard: fall back to (projected) steepest descent on a
         # non-descent direction.
@@ -213,26 +214,30 @@ def minimize_lbfgs(
             rho_hist=rho_hist,
             num_stored=num_stored,
             head=head,
+            evals=st["evals"] + ls.evals + 1,
             loss_hist=st["loss_hist"].at[jnp.minimum(it, config.history_len - 1)].set(f_new),
             gnorm_hist=st["gnorm_hist"].at[jnp.minimum(it, config.history_len - 1)].set(gn),
         )
 
     st = jax.lax.while_loop(cond, body, state0)
-    # Pad histories past the last iteration with the final values.
+    # Pad histories past the last iteration with the final values (projected
+    # norm under box constraints, consistent with in-loop entries).
+    final_gnorm = opt_gnorm(st["w"], st["g"])
     idx = jnp.arange(config.history_len)
     loss_hist = jnp.where(idx <= st["it"], st["loss_hist"], st["f"])
-    gnorm_hist = jnp.where(idx <= st["it"], st["gnorm_hist"], jnp.linalg.norm(st["g"]))
+    gnorm_hist = jnp.where(idx <= st["it"], st["gnorm_hist"], final_gnorm)
     reason = jnp.where(
         st["reason"] == REASON_NOT_CONVERGED, REASON_MAX_ITERATIONS, st["reason"]
     )
     return OptimizeResult(
         w=st["w"],
         value=st["f"],
-        grad_norm=jnp.linalg.norm(st["g"]),
+        grad_norm=final_gnorm,
         iterations=st["it"],
         reason_code=reason,
         loss_history=loss_hist,
         grad_norm_history=gnorm_hist,
+        evals=st["evals"],
     )
 
 

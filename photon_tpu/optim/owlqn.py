@@ -82,6 +82,7 @@ def minimize_owlqn(
         rho_hist=jnp.zeros((m,), dtype),
         num_stored=jnp.int32(0),
         head=jnp.int32(0),
+        evals=jnp.int32(1),
         loss_hist=jnp.full((hist_len,), F0, dtype),
         gnorm_hist=jnp.full((hist_len,), pg0_norm, dtype),
     )
@@ -125,7 +126,7 @@ def minimize_owlqn(
 
         w1 = _orthant_project(w + init_step * p, xi)
         F1, _f1, g1 = full_value(w1)
-        alpha, F_new, w_new, g_new, _evals = jax.lax.while_loop(
+        alpha, F_new, w_new, g_new, bt_evals = jax.lax.while_loop(
             bt_cond, bt_body, (init_step, F1, w1, g1, jnp.int32(1))
         )
 
@@ -150,6 +151,7 @@ def minimize_owlqn(
             w=w_new, F=F_new, g=g_new, it=it, reason=reason,
             s_hist=s_hist, y_hist=y_hist, rho_hist=rho_hist,
             num_stored=num_stored, head=head,
+            evals=st["evals"] + bt_evals,
             loss_hist=st["loss_hist"].at[jnp.minimum(it, config.history_len - 1)].set(F_new),
             gnorm_hist=st["gnorm_hist"].at[jnp.minimum(it, config.history_len - 1)].set(pgn),
         )
@@ -166,6 +168,7 @@ def minimize_owlqn(
         w=st["w"], value=st["F"], grad_norm=jnp.linalg.norm(pg_final),
         iterations=st["it"], reason_code=reason,
         loss_history=loss_hist, grad_norm_history=gnorm_hist,
+        evals=st["evals"],
     )
 
 

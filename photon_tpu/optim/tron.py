@@ -119,6 +119,7 @@ def minimize_tron(
     state0 = dict(
         w=w0, f=f0, g=g0, delta=delta0,
         it=jnp.int32(0), reason=jnp.int32(REASON_NOT_CONVERGED),
+        evals=jnp.int32(1),
         loss_hist=jnp.full((hist_len,), f0, dtype),
         gnorm_hist=jnp.full((hist_len,), g0_norm, dtype),
     )
@@ -145,13 +146,14 @@ def minimize_tron(
         snorm = jnp.linalg.norm(s_eff)
         accept = (rho > ETA0) & (pred > 0)
 
-        # LIBLINEAR-style trust-region radius update.
+        # Standard trust-region radius update: shrink on poor agreement,
+        # keep on moderate agreement, grow on strong agreement.
         delta_new = jnp.where(
             rho < ETA1,
             jnp.maximum(SIGMA1 * jnp.minimum(snorm, delta), 1e-12),
             jnp.where(
                 rho < ETA2,
-                jnp.clip(delta, SIGMA1 * delta, SIGMA2 * delta),
+                delta,
                 jnp.clip(SIGMA3 * snorm, delta, SIGMA3 * delta),
             ),
         )
@@ -174,6 +176,7 @@ def minimize_tron(
         )
         return dict(
             w=w_new, f=f_new, g=g_new, delta=delta_new, it=it, reason=reason,
+            evals=st["evals"] + 1,
             loss_hist=st["loss_hist"].at[jnp.minimum(it, config.history_len - 1)].set(f_new),
             gnorm_hist=st["gnorm_hist"].at[jnp.minimum(it, config.history_len - 1)].set(gn),
         )
@@ -189,4 +192,5 @@ def minimize_tron(
         w=st["w"], value=st["f"], grad_norm=jnp.linalg.norm(st["g"]),
         iterations=st["it"], reason_code=reason,
         loss_history=loss_hist, grad_norm_history=gnorm_hist,
+        evals=st["evals"],
     )

@@ -1,0 +1,189 @@
+"""Jitted GLMix training-step builders — the SPMD programs the drivers run.
+
+This is the TPU replacement for the reference's per-iteration Spark
+choreography (SURVEY.md §3.2): one compiled program trains the fixed-effect
+coordinate over the data-sharded batch (gradient psums inserted by XLA), and
+one compiled program per entity block trains all its random-effect models
+(vmapped solves over the entity-sharded block). Sharding layout:
+
+  batch arrays   (n, ...)  → P('data', ...)      gradient reductions on ICI
+  coefficients   (d,)      → P() or P('feature') (replicated / TP-sharded)
+  entity blocks  (E, ...)  → P('data', ...)      independent per-entity solves
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.data.random_effect import EntityBlock
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.common import OptimizerConfig
+from photon_tpu.optim.lbfgs import minimize_lbfgs
+from photon_tpu.parallel.mesh import DATA_AXIS
+
+Array = jax.Array
+
+
+def fixed_effect_step(
+    objective: GLMObjective, config: OptimizerConfig
+):
+    """Returns jitted (w0, batch) -> (w, value, iterations): a full L-BFGS
+    optimize of the fixed-effect coordinate as ONE XLA program."""
+
+    @jax.jit
+    def step(w0: Array, batch: LabeledBatch):
+        res = minimize_lbfgs(
+            lambda w: objective.value_and_grad(w, batch), w0, config
+        )
+        return res.w, res.value, res.iterations
+
+    return step
+
+
+def random_effect_step(
+    objective: GLMObjective, config: OptimizerConfig
+):
+    """Returns jitted (w0_block, block, offsets) -> (E, d) coefficients:
+    vmapped per-entity L-BFGS over one entity block."""
+
+    @jax.jit
+    def step(w0: Array, block: EntityBlock, offsets: Array):
+        def solve_one(feat, lab, wt, off, w_init):
+            lb = LabeledBatch(lab, feat, off, wt)
+            res = minimize_lbfgs(
+                lambda w: objective.value_and_grad(w, lb), w_init, config
+            )
+            return res.w
+
+        return jax.vmap(solve_one)(
+            block.features, block.label, block.weight, offsets, w0
+        )
+
+    return step
+
+
+def glmix_train_step(
+    fixed_objective: GLMObjective,
+    re_objective: GLMObjective,
+    fe_config: OptimizerConfig,
+    re_config: OptimizerConfig,
+):
+    """One full GLMix coordinate-descent pass as a single jittable function:
+
+      (w_fixed, re_coefs, fe_batch, re_block, base_offset) →
+          (w_fixed', re_coefs', scores)
+
+    Residual exchange between the two coordinates happens inside the program
+    (flat array arithmetic — reference CoordinateDescent.scala:441-446 role).
+    Designed to be jitted with shardings: fe_batch rows on 'data', re_block
+    entities on 'data', coefficients replicated.
+
+    Also returns exact work counters for throughput accounting:
+    ``fe_evals`` (fixed-effect objective evaluations incl. line search) and
+    ``re_sample_visits`` (Σ_e evals_e × n_e over entities).
+    """
+
+    def step(
+        w_fixed: Array,
+        re_coefs: Array,  # (E, d_re)
+        fe_batch: LabeledBatch,
+        re_block: EntityBlock,
+        re_features_flat: Array,  # (n, d_re) per-sample RE shard features
+        re_entity_ids: Array,  # (n,)
+    ):
+        # --- RE scores on the flat batch (gather by entity) ---
+        def re_scores_of(coefs):
+            valid = re_entity_ids >= 0
+            w = coefs[jnp.maximum(re_entity_ids, 0)]
+            return jnp.where(valid, jnp.sum(re_features_flat * w, axis=-1), 0.0)
+
+        # --- fixed effect trains against RE residuals ---
+        fe_res = minimize_lbfgs(
+            lambda w: fixed_objective.value_and_grad(
+                w, fe_batch.add_scores_to_offsets(re_scores_of(re_coefs))
+            ),
+            w_fixed,
+            fe_config,
+        )
+        w_fixed_new = fe_res.w
+
+        # --- fixed scores as residual offsets for the RE solves ---
+        fe_scores = fe_batch.margins(w_fixed_new)  # includes base offsets
+        offs = re_block.gather_offsets(fe_scores)
+
+        def solve_one(feat, lab, wt, off, w_init):
+            lb = LabeledBatch(lab, feat, off, wt)
+            res = minimize_lbfgs(
+                lambda w: re_objective.value_and_grad(w, lb), w_init, re_config
+            )
+            return res.w, res.evals
+
+        w_new, re_evals = jax.vmap(solve_one)(
+            re_block.features, re_block.label, re_block.weight, offs,
+            re_coefs[re_block.entity_idx],
+        )
+        re_coefs_new = re_coefs.at[re_block.entity_idx].set(w_new)
+        re_sample_visits = jnp.sum(
+            re_evals * jnp.sum((re_block.weight > 0).astype(jnp.int32), axis=1)
+        )
+
+        total_scores = fe_scores + re_scores_of(re_coefs_new)
+        return w_fixed_new, re_coefs_new, total_scores, fe_res.evals, re_sample_visits
+
+    return step
+
+
+def glmix_sharded_train_step(
+    mesh: Mesh,
+    fixed_objective: GLMObjective,
+    re_objective: GLMObjective,
+    fe_config: OptimizerConfig,
+    re_config: OptimizerConfig,
+):
+    """glmix_train_step jitted over a mesh, plus a placement function that
+    device_puts the inputs with the intended shardings (the program the
+    driver's dryrun_multichip compiles and runs).
+
+    Returns (jitted_step, place) where place(w_fixed, re_coefs, fe_batch,
+    re_block, re_features_flat, re_entity_ids) returns the sharded args.
+    """
+    step = glmix_train_step(fixed_objective, re_objective, fe_config, re_config)
+
+    repl = NamedSharding(mesh, P())
+    rows = NamedSharding(mesh, P(DATA_AXIS))
+    rows2d = NamedSharding(mesh, P(DATA_AXIS, None))
+    rows3d = NamedSharding(mesh, P(DATA_AXIS, None, None))
+
+    def place(w_fixed, re_coefs, fe_batch, re_block, re_features_flat, re_entity_ids):
+        put = jax.device_put
+        fe = LabeledBatch(
+            label=put(fe_batch.label, rows),
+            features=put(fe_batch.features, rows2d),
+            offset=put(fe_batch.offset, rows),
+            weight=put(fe_batch.weight, rows),
+            uid=None,
+        )
+        rb = EntityBlock(
+            entity_idx=put(re_block.entity_idx, rows),
+            features=put(re_block.features, rows3d),
+            label=put(re_block.label, rows2d),
+            weight=put(re_block.weight, rows2d),
+            sample_index=put(re_block.sample_index, rows2d),
+            train_mask=put(re_block.train_mask, rows),
+        )
+        return (
+            put(w_fixed, repl),
+            put(re_coefs, repl),
+            fe,
+            rb,
+            put(re_features_flat, rows2d),
+            put(re_entity_ids, rows),
+        )
+
+    return jax.jit(step, out_shardings=(repl, repl, rows, repl, repl)), place
