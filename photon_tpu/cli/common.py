@@ -1,0 +1,119 @@
+"""Shared CLI plumbing: config-string grammars + platform setup.
+
+Parity target: the reference's declarative scopt layer (photon-client
+io/scopt/ScoptParserHelpers.scala compound-argument grammar, e.g.
+``name=global,feature.shard=shardA,optimizer=LBFGS,reg.weights=0.1|1|10``
+from README.md:293-296) and per-driver parsers (io/scopt/game/*.scala).
+Implemented over argparse: each compound argument is a comma-separated
+key=value list; multi-values use ``|``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Dict, List, Optional
+
+from photon_tpu.estimators.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.io.data_reader import FeatureShardConfig
+from photon_tpu.types import OptimizerType, TaskType
+
+
+def setup_logging(verbose: bool = False) -> None:
+    logging.basicConfig(
+        level=logging.DEBUG if verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+
+def parse_kv(spec: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad key=value element {part!r} in {spec!r}")
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def parse_feature_shard_config(spec: str) -> Dict[str, FeatureShardConfig]:
+    """``name=shardA,feature.bags=features|songFeatures,intercept=true``"""
+    kv = parse_kv(spec)
+    name = kv.pop("name")
+    bags = kv.pop("feature.bags", "features").split("|")
+    intercept = kv.pop("intercept", "true").lower() != "false"
+    if kv:
+        raise ValueError(f"unknown feature-shard keys: {sorted(kv)}")
+    return {name: FeatureShardConfig(feature_bags=bags, has_intercept=intercept)}
+
+
+def parse_coordinate_config(spec: str):
+    """Reference coordinate-configurations grammar:
+
+    ``name=global,feature.shard=shardA,optimizer=LBFGS,reg.weights=0.1|1|10``
+    plus for random effects: ``random.effect.type=userId`` and optional
+    ``active.data.upper.bound= / active.data.lower.bound= /
+    features.to.samples.ratio=``. Additional keys: ``max.iter=``, ``tol=``,
+    ``reg.alpha=`` (elastic net), ``down.sampling.rate=``.
+    """
+    kv = parse_kv(spec)
+    name = kv.pop("name")
+    shard = kv.pop("feature.shard")
+    optimizer = OptimizerType[kv.pop("optimizer", "LBFGS").upper()]
+    reg_weights = [float(x) for x in kv.pop("reg.weights", "0").split("|")]
+    reg_alpha = float(kv.pop("reg.alpha", "0"))
+    max_iter = int(kv["max.iter"]) if "max.iter" in kv else None
+    kv.pop("max.iter", None)
+    tol = float(kv["tol"]) if "tol" in kv else None
+    kv.pop("tol", None)
+    re_type = kv.pop("random.effect.type", None)
+    if re_type is None:
+        rate = float(kv["down.sampling.rate"]) if "down.sampling.rate" in kv else None
+        kv.pop("down.sampling.rate", None)
+        if kv:
+            raise ValueError(f"unknown coordinate keys: {sorted(kv)}")
+        return FixedEffectCoordinateConfig(
+            coordinate_id=name, feature_shard=shard, optimizer=optimizer,
+            max_iter=max_iter, tol=tol, reg_weights=reg_weights,
+            reg_alpha=reg_alpha, down_sampling_rate=rate,
+        )
+    ub = int(kv["active.data.upper.bound"]) if "active.data.upper.bound" in kv else None
+    kv.pop("active.data.upper.bound", None)
+    lb = int(kv["active.data.lower.bound"]) if "active.data.lower.bound" in kv else None
+    kv.pop("active.data.lower.bound", None)
+    ratio = (
+        float(kv["features.to.samples.ratio"])
+        if "features.to.samples.ratio" in kv
+        else None
+    )
+    kv.pop("features.to.samples.ratio", None)
+    if kv:
+        raise ValueError(f"unknown coordinate keys: {sorted(kv)}")
+    return RandomEffectCoordinateConfig(
+        coordinate_id=name, re_type=re_type, feature_shard=shard,
+        optimizer=optimizer, max_iter=max_iter, tol=tol,
+        reg_weights=reg_weights, reg_alpha=reg_alpha,
+        active_upper_bound=ub, active_lower_bound=lb,
+        features_to_samples_ratio=ratio,
+    )
+
+
+def add_common_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--input-paths", nargs="+", required=True,
+                   help="Avro files/dirs/globs of training data")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-shard-configurations", nargs="+", default=["name=global"],
+                   help="name=<shard>,feature.bags=a|b,intercept=true")
+    p.add_argument("--task", default="LOGISTIC_REGRESSION",
+                   choices=[t.name for t in TaskType])
+    p.add_argument("--verbose", action="store_true")
+
+
+def task_of(args) -> TaskType:
+    return TaskType[args.task]

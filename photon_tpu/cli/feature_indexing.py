@@ -1,0 +1,67 @@
+"""Feature indexing driver: scan feature bags → persistent index map stores.
+
+Parity target: reference ``FeatureIndexingDriver``
+(photon-client index/FeatureIndexingDriver.scala:42-330): distinct feature
+scan (+intercept), hash-partitioned PalDB store files consumed later by
+PalDBIndexMapLoader. Here the store is either JSON (small maps) or the
+native mmap store (photon_tpu.data.native_index) when --num-partitions > 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict
+
+from photon_tpu.cli.common import parse_feature_shard_config, setup_logging
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.io.data_reader import _feature_key, read_avro_rows
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("feature-indexing")
+    p.add_argument("--input-paths", nargs="+", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-shard-configurations", nargs="+", default=["name=global"])
+    p.add_argument("--num-partitions", type=int, default=0,
+                   help=">0 writes the partitioned native mmap store instead of JSON")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def run(args) -> Dict:
+    setup_logging(args.verbose)
+    shard_configs: Dict = {}
+    for spec in args.feature_shard_configurations:
+        shard_configs.update(parse_feature_shard_config(spec))
+    rows = read_avro_rows(args.input_paths)
+    os.makedirs(args.output_dir, exist_ok=True)
+    out = {}
+    for shard, cfg in shard_configs.items():
+        keys = set()
+        for row in rows:
+            for bag in cfg.feature_bags:
+                for f in row.get(bag) or []:
+                    keys.add(_feature_key(f))
+        imap = IndexMap.build(keys, add_intercept=cfg.has_intercept)
+        if args.num_partitions > 0:
+            from photon_tpu.data.native_index import NativeIndexMapBuilder
+
+            store_dir = os.path.join(args.output_dir, f"index-store-{shard}")
+            NativeIndexMapBuilder(store_dir, args.num_partitions).build(imap)
+        else:
+            imap.save(os.path.join(args.output_dir, f"index-map-{shard}.json"))
+        out[shard] = len(imap)
+    with open(os.path.join(args.output_dir, "feature-indexing-summary.json"), "w") as f:
+        json.dump(out, f)
+    return out
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    print(json.dumps(run(args)))
+
+
+if __name__ == "__main__":
+    main()

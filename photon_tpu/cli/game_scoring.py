@@ -1,0 +1,108 @@
+"""GAME scoring driver.
+
+Parity target: reference ``GameScoringDriver`` (photon-client
+cli/game/scoring/GameScoringDriver.scala:39-284): feature maps → read data →
+load GameModel → GameTransformer → save ScoringResultAvro (+ optional
+evaluation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict
+
+import numpy as np
+
+from photon_tpu.cli.common import (
+    add_common_args,
+    parse_feature_shard_config,
+    setup_logging,
+)
+from photon_tpu.data.index_map import EntityIndex, IndexMap
+from photon_tpu.estimators.game_transformer import GameTransformer
+from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
+from photon_tpu.io.data_reader import read_merged
+from photon_tpu.io.model_io import METADATA_FILE, load_game_model
+from photon_tpu.io.scores import save_scores
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("game-scoring")
+    add_common_args(p)
+    p.add_argument("--model-input-dir", required=True)
+    p.add_argument("--model-artifacts-dir", default=None,
+                   help="dir holding index-map-*.json / entity-index-*.json "
+                        "(defaults to the training output dir = parent of model dir)")
+    p.add_argument("--evaluators", nargs="*", default=[])
+    p.add_argument("--model-id", default="game-model")
+    return p
+
+
+def run(args) -> Dict:
+    setup_logging(args.verbose)
+    shard_configs: Dict = {}
+    for spec in args.feature_shard_configurations:
+        shard_configs.update(parse_feature_shard_config(spec))
+
+    artifacts = args.model_artifacts_dir or os.path.dirname(
+        args.model_input_dir.rstrip("/")
+    )
+    index_maps = {}
+    for shard in shard_configs:
+        index_maps[shard] = IndexMap.load(
+            os.path.join(artifacts, f"index-map-{shard}.json")
+        )
+    entity_indexes: Dict[str, EntityIndex] = {}
+    with open(os.path.join(args.model_input_dir, METADATA_FILE)) as f:
+        meta = json.load(f)
+    re_types = [
+        info["reType"] for info in meta["coordinates"].values() if info["type"] == "random"
+    ]
+    for re_type in re_types:
+        path = os.path.join(artifacts, f"entity-index-{re_type}.json")
+        if os.path.exists(path):
+            entity_indexes[re_type] = EntityIndex.load(path)
+
+    model = load_game_model(args.model_input_dir, index_maps, entity_indexes)
+
+    batch, _, _ = read_merged(
+        args.input_paths, shard_configs, index_maps=index_maps,
+        entity_id_columns={rt: rt for rt in re_types},
+        entity_indexes=entity_indexes, intern_new_entities=False,
+    )
+
+    suite = None
+    if args.evaluators:
+        num_entities = {k: len(v) for k, v in entity_indexes.items()}
+        suite = EvaluationSuite(
+            [EvaluatorSpec.parse(e) for e in args.evaluators], num_entities
+        )
+    transformer = GameTransformer(model, suite)
+    scores = transformer.transform(batch)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    save_scores(
+        os.path.join(args.output_dir, "scores.avro"),
+        np.asarray(scores),
+        args.model_id,
+        uids=[str(int(u)) for u in np.asarray(batch.uid)],
+        labels=np.asarray(batch.label),
+        weights=np.asarray(batch.weight),
+    )
+    out = {"numScored": int(scores.shape[0])}
+    if suite is not None:
+        out["metrics"] = transformer.last_metrics
+        with open(os.path.join(args.output_dir, "scoring-metrics.json"), "w") as f:
+            json.dump(transformer.last_metrics, f, indent=2)
+    return out
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    print(json.dumps(run(args)))
+
+
+if __name__ == "__main__":
+    main()

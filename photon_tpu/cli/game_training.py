@@ -1,0 +1,179 @@
+"""GAME training driver.
+
+Parity target: reference ``GameTrainingDriver`` (photon-client
+cli/game/training/GameTrainingDriver.scala:54-873): read train/validation
+Avro → feature maps → stats/normalization → reg-weight cross-product →
+GameEstimator.fit → model selection → save models + index maps.
+
+Usage example (grammar mirrors README.md:293-296):
+
+  python -m photon_tpu.cli.game_training \\
+    --input-paths train/ --validation-paths valid/ --output-dir out/ \\
+    --feature-shard-configurations name=globalShard \\
+    --coordinate-configurations \\
+      name=global,feature.shard=globalShard,optimizer=LBFGS,reg.weights=0.1|1|10 \\
+      name=perUser,feature.shard=globalShard,random.effect.type=userId,reg.weights=1 \\
+    --update-sequence global,perUser --evaluators AUC
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.cli.common import (
+    add_common_args,
+    parse_coordinate_config,
+    parse_feature_shard_config,
+    setup_logging,
+    task_of,
+)
+from photon_tpu.data.normalization import build_normalization_context
+from photon_tpu.data.stats import compute_feature_stats
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.estimators.game_estimator import GameEstimator
+from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
+from photon_tpu.io.data_reader import read_merged
+from photon_tpu.io.model_io import load_game_model, save_game_model
+from photon_tpu.types import NormalizationType
+from photon_tpu.utils.timed import Timed
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("game-training")
+    add_common_args(p)
+    p.add_argument("--validation-paths", nargs="*", default=None)
+    p.add_argument("--coordinate-configurations", nargs="+", required=True)
+    p.add_argument("--update-sequence", required=True,
+                   help="comma-separated coordinate ids")
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument("--evaluators", nargs="*", default=["AUC"])
+    p.add_argument("--normalization", default="NONE",
+                   choices=[t.name for t in NormalizationType])
+    p.add_argument("--model-input-dir", default=None, help="warm-start model dir")
+    p.add_argument("--locked-coordinates", default="",
+                   help="comma-separated coordinate ids to keep fixed (partial retrain)")
+    p.add_argument("--output-mode", default="BEST", choices=["BEST", "ALL", "NONE"])
+    p.add_argument("--variance-computation", action="store_true")
+    return p
+
+
+def run(args) -> Dict:
+    setup_logging(args.verbose)
+    task = task_of(args)
+
+    shard_configs: Dict = {}
+    for spec in args.feature_shard_configurations:
+        shard_configs.update(parse_feature_shard_config(spec))
+    coord_configs = [parse_coordinate_config(s) for s in args.coordinate_configurations]
+    update_sequence = [s.strip() for s in args.update_sequence.split(",") if s.strip()]
+    by_id = {c.coordinate_id: c for c in coord_configs}
+    coord_configs = [by_id[cid] for cid in update_sequence]  # order = sequence
+
+    entity_id_columns = {
+        c.re_type: c.re_type
+        for c in coord_configs
+        if hasattr(c, "re_type")
+    }
+
+    with Timed("driver/read-train"):
+        batch, index_maps, entity_indexes = read_merged(
+            args.input_paths, shard_configs, entity_id_columns=entity_id_columns
+        )
+    valid_batch = None
+    if args.validation_paths:
+        with Timed("driver/read-validation"):
+            valid_batch, _, _ = read_merged(
+                args.validation_paths, shard_configs, index_maps=index_maps,
+                entity_id_columns=entity_id_columns, entity_indexes=entity_indexes,
+                intern_new_entities=False,
+            )
+
+    # Feature stats + normalization per shard (GameTrainingDriver.scala:434-440).
+    intercept_indices = {
+        shard: index_maps[shard].get_index(IndexMap.INTERCEPT)
+        for shard in shard_configs
+        if index_maps[shard].get_index(IndexMap.INTERCEPT) >= 0
+    }
+    normalization = {}
+    norm_type = NormalizationType[args.normalization]
+    if norm_type != NormalizationType.NONE:
+        for shard in shard_configs:
+            stats = compute_feature_stats(
+                batch.labeled_batch(shard), intercept_indices.get(shard)
+            )
+            normalization[shard] = build_normalization_context(
+                norm_type, stats.mean, stats.std, stats.abs_max,
+                intercept_indices.get(shard),
+            )
+
+    warm = None
+    if args.model_input_dir:
+        warm = load_game_model(args.model_input_dir, index_maps, entity_indexes)
+
+    num_entities = {k: len(v) for k, v in entity_indexes.items()}
+    suite = EvaluationSuite(
+        [EvaluatorSpec.parse(e) for e in args.evaluators], num_entities
+    ) if args.evaluators else None
+
+    estimator = GameEstimator(
+        task=task,
+        coordinate_configs=coord_configs,
+        num_iterations=args.coordinate_descent_iterations,
+        intercept_indices=intercept_indices,
+        normalization=normalization,
+        num_entities=num_entities,
+        locked_coordinates=[s for s in args.locked_coordinates.split(",") if s],
+        variance_computation=args.variance_computation,
+    )
+    results = estimator.fit(
+        batch,
+        validation_batch=valid_batch,
+        evaluation_suite=suite if valid_batch is not None else None,
+        initial_model=warm,
+    )
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    summary = {"configs": [], "best": None}
+    best = (
+        estimator.select_best(results, suite)
+        if suite is not None and valid_batch is not None
+        else results[-1]
+    )
+    for i, r in enumerate(results):
+        entry = {"config": r.config.describe(), "metrics": r.metrics}
+        summary["configs"].append(entry)
+        if args.output_mode == "ALL":
+            save_game_model(
+                r.model, os.path.join(args.output_dir, f"models", str(i)),
+                index_maps, entity_indexes,
+            )
+    if args.output_mode in ("BEST", "ALL"):
+        save_game_model(
+            best.model, os.path.join(args.output_dir, "best"),
+            index_maps, entity_indexes,
+            extra_metadata={"config": best.config.describe()},
+        )
+        for shard, imap in index_maps.items():
+            imap.save(os.path.join(args.output_dir, f"index-map-{shard}.json"))
+        for re_type, eidx in entity_indexes.items():
+            eidx.save(os.path.join(args.output_dir, f"entity-index-{re_type}.json"))
+    summary["best"] = {"config": best.config.describe(), "metrics": best.metrics}
+    with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    return summary
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    summary = run(args)
+    print(json.dumps(summary["best"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,238 @@
+"""Legacy single-GLM training driver.
+
+Parity target: reference legacy ``Driver`` (photon-client Driver.scala:60-558)
+with its INIT→PREPROCESSED→TRAINED→VALIDATED stage machine (DriverStage
+.scala:20-55): read data (Avro or LIBSVM) → summarize/normalize → λ sweep
+with warm start (ModelTraining.trainGeneralizedLinearModel role,
+photon-api ModelTraining.scala:54-200) → validate per λ → select best by the
+task's default metric → write models (text + Avro) + lifecycle events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import enum
+import json
+import os
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.cli.common import setup_logging, task_of
+from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.data.normalization import build_normalization_context
+from photon_tpu.data.stats import compute_feature_stats
+from photon_tpu.evaluation.evaluators import EvaluatorType, evaluate, metric_is_better
+from photon_tpu.io.data_reader import FeatureShardConfig, read_merged
+from photon_tpu.io.libsvm import read_libsvm
+from photon_tpu.io.model_io import save_game_model
+from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_SCHEMA
+from photon_tpu.io.avro import write_avro_records
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.game import FixedEffectModel, GameModel
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.factory import OptimizerSpec, make_optimizer
+from photon_tpu.types import NormalizationType, OptimizerType, TaskType
+from photon_tpu.utils.events import (
+    EventEmitter,
+    optimization_log_event,
+    training_finish_event,
+    training_start_event,
+)
+
+DEFAULT_METRIC = {
+    TaskType.LOGISTIC_REGRESSION: EvaluatorType.AUC,
+    TaskType.LINEAR_REGRESSION: EvaluatorType.RMSE,
+    TaskType.POISSON_REGRESSION: EvaluatorType.POISSON_LOSS,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: EvaluatorType.AUC,
+}
+
+
+class DriverStage(enum.Enum):
+    """Reference DriverStage.scala:20-55 state machine."""
+
+    INIT = 0
+    PREPROCESSED = 1
+    TRAINED = 2
+    VALIDATED = 3
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("train-glm")
+    p.add_argument("--training-data", required=True,
+                   help="Avro path/dir/glob, or LIBSVM text file with --format libsvm")
+    p.add_argument("--validation-data", default=None)
+    p.add_argument("--format", default="avro", choices=["avro", "libsvm"])
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", default="LOGISTIC_REGRESSION", choices=[t.name for t in TaskType])
+    p.add_argument("--optimizer", default="LBFGS", choices=[o.name for o in OptimizerType])
+    p.add_argument("--regularization-weights", default="0.1,1,10,100")
+    p.add_argument("--elastic-net-alpha", type=float, default=0.0)
+    p.add_argument("--max-iterations", type=int, default=None)
+    p.add_argument("--tolerance", type=float, default=None)
+    p.add_argument("--normalization", default="NONE", choices=[t.name for t in NormalizationType])
+    p.add_argument("--intercept", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--coefficient-box", default=None,
+                   help="lower,upper box constraint applied to all coefficients")
+    p.add_argument("--compute-variance", action="store_true")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   help="dotted paths of event listener callables")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def _load(args, path: Optional[str], index_map=None):
+    if path is None:
+        return None, index_map
+    if args.format == "libsvm":
+        X, y = read_libsvm(path)
+        if args.intercept:
+            X = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], axis=1)
+        imap = index_map or IndexMap.build(
+            [str(j + 1) for j in range(X.shape[1] - (1 if args.intercept else 0))],
+            add_intercept=args.intercept,
+        )
+        return LabeledBatch(jnp.asarray(y), jnp.asarray(X)), imap
+    cfg = {"features": FeatureShardConfig(feature_bags=["features"], has_intercept=args.intercept)}
+    batch, imaps, _ = read_merged(
+        [path], cfg, index_maps=None if index_map is None else {"features": index_map}
+    )
+    return batch.labeled_batch("features"), imaps["features"]
+
+
+def run(args) -> Dict:
+    setup_logging(args.verbose)
+    task = task_of(args)
+    stage = DriverStage.INIT
+    emitter = EventEmitter()
+    for name in args.event_listeners:
+        emitter.register_by_name(name)
+
+    train, imap = _load(args, args.training_data)
+    valid, _ = _load(args, args.validation_data, imap)
+    icpt = imap.get_index(IndexMap.INTERCEPT) if args.intercept else None
+    if icpt is not None and icpt < 0:
+        icpt = None
+
+    norm = None
+    norm_type = NormalizationType[args.normalization]
+    if norm_type != NormalizationType.NONE:
+        stats = compute_feature_stats(train, icpt)
+        norm = build_normalization_context(norm_type, stats.mean, stats.std, stats.abs_max, icpt)
+    stage = DriverStage.PREPROCESSED
+
+    box = None
+    if args.coefficient_box:
+        lo, hi = (float(x) for x in args.coefficient_box.split(","))
+        d = train.dim
+        box = (jnp.full((d,), lo, jnp.float32), jnp.full((d,), hi, jnp.float32))
+
+    weights = sorted(float(x) for x in args.regularization_weights.split(","))
+    weights.reverse()  # strongest first: warm start toward weaker reg
+    loss = loss_for_task(task)
+    emitter.emit(training_start_event(task=task.value, weights=weights))
+
+    models: List[Dict] = []
+    w = jnp.zeros((train.dim,), jnp.float32)
+    for lam in weights:
+        objective = GLMObjective(
+            loss=loss,
+            l2_weight=(1.0 - args.elastic_net_alpha) * lam,
+            l1_weight=args.elastic_net_alpha * lam,
+            intercept_index=icpt,
+            normalization=norm,
+        )
+        spec = OptimizerSpec(
+            OptimizerType[args.optimizer], args.max_iterations, args.tolerance, box=box
+        )
+        solve = make_optimizer(objective, spec)
+        result = solve(w, train)
+        w = result.w  # warm start (ModelTraining.scala:162-200)
+        w_model = norm.transformed_to_model_space(w) if norm is not None else w
+        variances = None
+        if args.compute_variance:
+            diag = objective.hessian_diagonal(w, train)
+            variances = 1.0 / jnp.maximum(diag, 1e-12)
+        models.append(
+            {
+                "lambda": lam,
+                "w": w_model,
+                "variances": variances,
+                "loss": float(result.value),
+                "iterations": int(result.iterations),
+                "reason": result.convergence_reason.value,
+            }
+        )
+        emitter.emit(
+            optimization_log_event(
+                reg_weight=lam, loss=float(result.value),
+                iterations=int(result.iterations),
+                convergence=result.convergence_reason.value,
+            )
+        )
+    stage = DriverStage.TRAINED
+
+    # Validation + model selection (Driver.modelSelection:416 role).
+    metric_type = DEFAULT_METRIC[task]
+    best_idx = len(models) - 1
+    if valid is not None:
+        better = metric_is_better(metric_type)
+        best_val = None
+        for i, m in enumerate(models):
+            scores = valid.margins(m["w"])
+            if task == TaskType.LOGISTIC_REGRESSION:
+                pass  # AUC on margins is rank-equivalent
+            v = float(evaluate(metric_type, scores, valid.label, valid.weight))
+            m["validation"] = {metric_type.value: v}
+            if best_val is None or better(v, best_val):
+                best_val, best_idx = v, i
+        stage = DriverStage.VALIDATED
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    # Text models (IOUtils.writeModelsInText role): one file per λ.
+    for m in models:
+        path = os.path.join(args.output_dir, f"model-lambda-{m['lambda']:g}.txt")
+        with open(path, "w") as f:
+            f.write(f"# task={task.value} lambda={m['lambda']:g} loss={m['loss']:.6e}\n")
+            wv = np.asarray(m["w"])
+            for j in np.flatnonzero(np.abs(wv) > 0):
+                key = imap.get_feature_name(int(j)) or str(j)
+                f.write(f"{key}\t{wv[j]:.8g}\n")
+    # Avro model output for the best model (BayesianLinearModelAvro).
+    best = models[best_idx]
+    game = GameModel(
+        {
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(best["w"], best["variances"]), task
+                ),
+                "features",
+            )
+        }
+    )
+    save_game_model(game, os.path.join(args.output_dir, "best"), {"features": imap})
+    summary = {
+        "best_lambda": best["lambda"],
+        "models": [
+            {k: v for k, v in m.items() if k not in ("w", "variances")} for m in models
+        ],
+        "stage": stage.name,
+    }
+    with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    emitter.emit(training_finish_event(best_lambda=best["lambda"]))
+    return summary
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    summary = run(args)
+    print(json.dumps({"best_lambda": summary["best_lambda"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,229 @@
+"""Native mmap feature index store: builder + ctypes reader + pure fallback.
+
+Parity target: reference PalDB off-heap partitioned index
+(photon-api index/PalDBIndexMap.scala:43-240, loader
+PalDBIndexMapLoader.scala:25-100, builder PalDBIndexMapBuilder): feature
+name→index and index→name in N hash-partitioned store files, memory-mapped
+per reader so huge feature spaces never enter the Python heap.
+
+Store format: see photon_tpu/native/index_store.cpp. The builder writes the
+binary files from Python (numpy); reads go through the C++ library when it
+can be built (ctypes), else a pure-Python mmap reader of the same files.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import mmap
+import os
+import struct
+import subprocess
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+_MAGIC = 0x50494458
+_ENTRY = struct.Struct("<QIII")  # hash, value, key_off, key_len
+_REV = struct.Struct("<II")
+
+_FNV_OFFSET = 1469598103934665603
+_FNV_PRIME = 1099511628211
+_MASK = (1 << 64) - 1
+
+
+def _fnv1a64(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _MASK
+    return h
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "..", "native", "libindex_store.so")
+
+
+def build_native_lib(force: bool = False) -> Optional[str]:
+    """Compile the C++ store reader (g++ -O2 -shared). Returns the .so path
+    or None when no toolchain is available."""
+    so = os.path.abspath(_lib_path())
+    src = os.path.join(os.path.dirname(so), "index_store.cpp")
+    if os.path.exists(so) and not force:
+        return so
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, src],
+            check=True, capture_output=True,
+        )
+        return so
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return None
+
+
+class NativeIndexMapBuilder:
+    """Writes the partitioned store files (PalDBIndexMapBuilder role)."""
+
+    def __init__(self, store_dir: str, num_partitions: int = 4):
+        self.store_dir = store_dir
+        self.num_partitions = num_partitions
+
+    def build(self, index_map) -> None:
+        os.makedirs(self.store_dir, exist_ok=True)
+        parts: List[List[Tuple[int, int, bytes]]] = [
+            [] for _ in range(self.num_partitions)
+        ]
+        total = 0
+        for key, value in index_map.items():
+            kb = key.encode("utf-8")
+            h = _fnv1a64(kb)
+            parts[h % self.num_partitions].append((h, value, kb))
+            total = max(total, value + 1)
+
+        rev = np.zeros((total, 2), np.uint32)
+        for pi, entries in enumerate(parts):
+            entries.sort(key=lambda e: e[0])
+            blob = bytearray()
+            packed = bytearray()
+            for slot, (h, value, kb) in enumerate(entries):
+                packed += _ENTRY.pack(h, value, len(blob), len(kb))
+                rev[value] = (pi, slot)
+                blob += kb
+            with open(os.path.join(self.store_dir, f"part-{pi}.bin"), "wb") as f:
+                f.write(struct.pack("<II", _MAGIC, len(entries)))
+                f.write(bytes(packed))
+                f.write(bytes(blob))
+        with open(os.path.join(self.store_dir, "reverse.bin"), "wb") as f:
+            f.write(struct.pack("<II", _MAGIC, total))
+            f.write(rev.astype("<u4").tobytes())
+        with open(os.path.join(self.store_dir, "meta.json"), "w") as f:
+            json.dump({"numPartitions": self.num_partitions, "size": total}, f)
+
+
+class _PurePart:
+    def __init__(self, path: str):
+        self._f = open(path, "rb")
+        self.mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        magic, self.n = struct.unpack_from("<II", self.mm, 0)
+        assert magic == _MAGIC, f"bad store file {path}"
+        self.entries_off = 8
+        self.keys_off = 8 + self.n * _ENTRY.size
+        # hashes as numpy view for vectorized binary search
+        raw = np.frombuffer(self.mm, dtype=np.uint8,
+                            count=self.n * _ENTRY.size, offset=8)
+        self.table = raw.view(np.dtype([("hash", "<u8"), ("value", "<u4"),
+                                        ("off", "<u4"), ("len", "<u4")]))
+
+    def entry(self, slot: int):
+        return self.table[slot]
+
+    def key_bytes(self, off: int, length: int) -> bytes:
+        start = self.keys_off + off
+        return self.mm[start : start + length]
+
+    def close(self):
+        # Drop numpy views into the mmap before closing it.
+        self.table = None
+        self.mm.close()
+        self._f.close()
+
+
+class NativeIndexMap:
+    """Reader over a partitioned store (PalDBIndexMap role). Uses the C++
+    library when available; same files either way."""
+
+    def __init__(self, store_dir: str, use_native: bool = True):
+        with open(os.path.join(store_dir, "meta.json")) as f:
+            meta = json.load(f)
+        self.store_dir = store_dir
+        self.num_partitions = meta["numPartitions"]
+        self._size = meta["size"]
+        self._lib = None
+        self._handle = None
+        if use_native:
+            so = build_native_lib()
+            if so is not None:
+                lib = ctypes.CDLL(so)
+                lib.pidx_open.restype = ctypes.c_void_p
+                lib.pidx_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+                lib.pidx_get_index.restype = ctypes.c_int64
+                lib.pidx_get_index.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+                lib.pidx_get_name.restype = ctypes.c_int64
+                lib.pidx_get_name.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_char_p)
+                ]
+                lib.pidx_get_indices.restype = None
+                lib.pidx_get_indices.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p,
+                    np.ctypeslib.ndpointer(np.int64), ctypes.c_int64,
+                    np.ctypeslib.ndpointer(np.int64),
+                ]
+                lib.pidx_close.argtypes = [ctypes.c_void_p]
+                handle = lib.pidx_open(store_dir.encode(), self.num_partitions)
+                if handle:
+                    self._lib, self._handle = lib, handle
+        if self._lib is None:
+            self._parts = [
+                _PurePart(os.path.join(store_dir, f"part-{i}.bin"))
+                for i in range(self.num_partitions)
+            ]
+            with open(os.path.join(store_dir, "reverse.bin"), "rb") as f:
+                raw = f.read()
+            magic, total = struct.unpack_from("<II", raw, 0)
+            assert magic == _MAGIC
+            self._rev = np.frombuffer(raw, dtype="<u4", offset=8).reshape(total, 2)
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
+
+    def __len__(self) -> int:
+        return self._size
+
+    def get_index(self, key: str) -> int:
+        kb = key.encode("utf-8")
+        if self._lib is not None:
+            return int(self._lib.pidx_get_index(self._handle, kb, len(kb)))
+        h = _fnv1a64(kb)
+        part = self._parts[h % self.num_partitions]
+        lo = int(np.searchsorted(part.table["hash"], np.uint64(h), side="left"))
+        for i in range(lo, part.n):
+            e = part.entry(i)
+            if int(e["hash"]) != h:
+                break
+            if part.key_bytes(int(e["off"]), int(e["len"])) == kb:
+                return int(e["value"])
+        return -1
+
+    def get_indices(self, keys: List[str]) -> np.ndarray:
+        """Batched lookup (the ingest hot path)."""
+        if self._lib is not None:
+            blobs = [k.encode("utf-8") for k in keys]
+            offsets = np.zeros(len(blobs) + 1, np.int64)
+            np.cumsum([len(b) for b in blobs], out=offsets[1:])
+            blob = b"".join(blobs)
+            out = np.empty(len(blobs), np.int64)
+            self._lib.pidx_get_indices(self._handle, blob, offsets, len(blobs), out)
+            return out
+        return np.array([self.get_index(k) for k in keys], np.int64)
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        if self._lib is not None:
+            ptr = ctypes.c_char_p()
+            n = self._lib.pidx_get_name(self._handle, index, ctypes.byref(ptr))
+            if n < 0:
+                return None
+            return ctypes.string_at(ptr, n).decode("utf-8")
+        if index < 0 or index >= self._rev.shape[0]:
+            return None
+        pi, slot = (int(x) for x in self._rev[index])
+        part = self._parts[pi]
+        e = part.entry(slot)
+        return part.key_bytes(int(e["off"]), int(e["len"])).decode("utf-8")
+
+    def close(self):
+        if self._lib is not None:
+            self._lib.pidx_close(self._handle)
+            self._lib = None
+        elif hasattr(self, "_parts"):
+            for p in self._parts:
+                p.close()

@@ -1,0 +1,7 @@
+from photon_tpu.estimators.config import (  # noqa: F401
+    FixedEffectCoordinateConfig,
+    GameOptimizationConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.estimators.game_estimator import GameEstimator, GameResult  # noqa: F401
+from photon_tpu.estimators.game_transformer import GameTransformer  # noqa: F401

@@ -1,0 +1,110 @@
+"""Coordinate configuration model.
+
+Parity targets: reference ``CoordinateDataConfiguration`` subclasses
+(photon-api data/CoordinateDataConfiguration.scala:22-76),
+``CoordinateOptimizationConfiguration`` + ``RegularizationContext``
+(photon-api optimization/), and the client-side ``CoordinateConfiguration``
+expansion of regularization-weight sets into per-weight optimization configs
+(photon-client io/CoordinateConfiguration.scala).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from photon_tpu.optim.factory import OptimizerSpec
+from photon_tpu.types import OptimizerType
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationConfig:
+    """L1/L2/elastic-net weight split (reference RegularizationContext).
+
+    ``alpha`` is the elastic-net mixing: l1 = alpha*weight,
+    l2 = (1-alpha)*weight. alpha=0 → pure L2, alpha=1 → pure L1.
+    """
+
+    weight: float = 0.0
+    alpha: float = 0.0
+
+    @property
+    def l1(self) -> float:
+        return self.alpha * self.weight
+
+    @property
+    def l2(self) -> float:
+        return (1.0 - self.alpha) * self.weight
+
+
+@dataclasses.dataclass
+class FixedEffectCoordinateConfig:
+    coordinate_id: str
+    feature_shard: str
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    max_iter: Optional[int] = None
+    tol: Optional[float] = None
+    reg_weights: Sequence[float] = (0.0,)
+    reg_alpha: float = 0.0
+    down_sampling_rate: Optional[float] = None
+    compute_variance: bool = False
+
+    def optimizer_spec(self) -> OptimizerSpec:
+        return OptimizerSpec(self.optimizer, self.max_iter, self.tol)
+
+
+@dataclasses.dataclass
+class RandomEffectCoordinateConfig:
+    coordinate_id: str
+    re_type: str
+    feature_shard: str
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    max_iter: Optional[int] = None
+    tol: Optional[float] = None
+    reg_weights: Sequence[float] = (0.0,)
+    reg_alpha: float = 0.0
+    active_upper_bound: Optional[int] = None
+    active_lower_bound: Optional[int] = None
+    features_to_samples_ratio: Optional[float] = None
+    compute_variance: bool = False
+
+    def optimizer_spec(self) -> OptimizerSpec:
+        return OptimizerSpec(self.optimizer, self.max_iter, self.tol)
+
+
+CoordinateConfig = object  # FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class GameOptimizationConfig:
+    """One point of the regularization-weight cross-product: coordinate id →
+    regularization (prepareGameOptConfigs role, GameTrainingDriver.scala:632-641)."""
+
+    reg: Dict[str, RegularizationConfig]
+
+    def describe(self) -> str:
+        return ", ".join(f"{k}: λ={v.weight:g} α={v.alpha:g}" for k, v in self.reg.items())
+
+
+def expand_optimization_configs(
+    configs: Sequence[CoordinateConfig],
+) -> List[GameOptimizationConfig]:
+    """Cross-product of per-coordinate reg-weight sets, ordered ascending per
+    coordinate so warm starts move from strong to weak regularization like
+    the reference's sweep (ModelTraining.scala:162-200 sorts weights)."""
+    import itertools
+
+    ids = [c.coordinate_id for c in configs]
+    weight_lists = [sorted(c.reg_weights, reverse=True) for c in configs]
+    alphas = {c.coordinate_id: c.reg_alpha for c in configs}
+    out = []
+    for combo in itertools.product(*weight_lists):
+        out.append(
+            GameOptimizationConfig(
+                {
+                    cid: RegularizationConfig(weight=w, alpha=alphas[cid])
+                    for cid, w in zip(ids, combo)
+                }
+            )
+        )
+    return out

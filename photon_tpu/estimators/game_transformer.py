@@ -1,0 +1,35 @@
+"""GameTransformer: batch scoring with a trained GameModel.
+
+Parity target: reference ``GameTransformer`` (photon-api
+transformers/GameTransformer.scala:39-318): load model → score a dataset →
+optional evaluation; logValue of metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import jax
+
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.evaluation.suite import EvaluationSuite
+from photon_tpu.models.game import GameModel
+
+Array = jax.Array
+logger = logging.getLogger(__name__)
+
+
+class GameTransformer:
+    def __init__(self, model: GameModel, evaluation_suite: Optional[EvaluationSuite] = None):
+        self.model = model
+        self.evaluation_suite = evaluation_suite
+
+    def transform(self, batch: GameBatch) -> Array:
+        """Per-sample total scores (model + offsets), jitted."""
+        scores = jax.jit(self.model.score_with_offset)(batch)
+        if self.evaluation_suite is not None:
+            metrics = self.evaluation_suite.evaluate_scores(scores, batch)
+            logger.info("scoring evaluation: %s", metrics)
+            self.last_metrics: Optional[Dict[str, float]] = metrics
+        return scores

@@ -1,0 +1,8 @@
+from photon_tpu.io.avro import AvroReader, AvroWriter, parse_schema  # noqa: F401
+from photon_tpu.io.schemas import (  # noqa: F401
+    BAYESIAN_LINEAR_MODEL_SCHEMA,
+    FEATURE_SUMMARIZATION_SCHEMA,
+    RESPONSE_PREDICTION_SCHEMA,
+    SCORING_RESULT_SCHEMA,
+    TRAINING_EXAMPLE_SCHEMA,
+)

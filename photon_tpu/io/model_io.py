@@ -1,0 +1,240 @@
+"""GAME model persistence: Avro-compatible save/load with warm-start support.
+
+Parity target: reference ``ModelProcessingUtils`` (photon-client
+data/avro/ModelProcessingUtils.scala:59-700): directory layout
+``fixed-effect/<name>/coefficients/`` + ``random-effect/<name>/``
+with per-entity ``BayesianLinearModelAvro`` records, ``id-info`` files naming
+the RE type, JSON ``model-metadata``, and sparsity-thresholded coefficient
+output. Models saved here can warm-start later runs (loadGameModelFromHDFS
+role) and are structured for interop with reference tooling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.index_map import EntityIndex, IndexMap
+from photon_tpu.io.avro import read_avro_records, write_avro_records
+from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_SCHEMA
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.types import TaskType
+
+FIXED_DIR = "fixed-effect"
+RANDOM_DIR = "random-effect"
+METADATA_FILE = "model-metadata.json"
+ID_INFO_FILE = "id-info"
+COEFF_DIR = "coefficients"
+
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION: "LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION: "LinearRegressionModel",
+    TaskType.POISSON_REGRESSION: "PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_MODEL = {v: k for k, v in _MODEL_CLASS.items()}
+
+
+def _split_key(key: str) -> Tuple[str, str]:
+    if IndexMap.DELIM in key:
+        name, term = key.split(IndexMap.DELIM, 1)
+        return name, term
+    return key, ""
+
+
+def _coeffs_to_avro(
+    model_id: str,
+    means: np.ndarray,
+    variances: Optional[np.ndarray],
+    index_map: IndexMap,
+    task: TaskType,
+    sparsity_threshold: float,
+) -> dict:
+    rows = []
+    var_rows = [] if variances is not None else None
+    for j in np.flatnonzero(np.abs(means) > sparsity_threshold):
+        key = index_map.get_feature_name(int(j))
+        if key is None:
+            continue
+        name, term = _split_key(key)
+        rows.append({"name": name, "term": term, "value": float(means[j])})
+        if var_rows is not None:
+            var_rows.append({"name": name, "term": term, "value": float(variances[j])})
+    return {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS[task],
+        "means": rows,
+        "variances": var_rows,
+        "lossFunction": loss_for_task(task).name,
+    }
+
+
+def _avro_to_coeffs(rec: dict, index_map: IndexMap, dim: int):
+    means = np.zeros(dim, np.float32)
+    for ntv in rec["means"]:
+        key = IndexMap.key(ntv["name"], ntv["term"])
+        j = index_map.get_index(key)
+        if j >= 0:
+            means[j] = ntv["value"]
+    variances = None
+    if rec.get("variances"):
+        variances = np.zeros(dim, np.float32)
+        for ntv in rec["variances"]:
+            j = index_map.get_index(IndexMap.key(ntv["name"], ntv["term"]))
+            if j >= 0:
+                variances[j] = ntv["value"]
+    task = _CLASS_MODEL.get(rec.get("modelClass") or "", TaskType.LOGISTIC_REGRESSION)
+    return means, variances, task
+
+
+def save_game_model(
+    model: GameModel,
+    output_dir: str,
+    index_maps: Dict[str, IndexMap],  # feature-shard -> IndexMap
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,  # RE type -> index
+    sparsity_threshold: float = 1e-4,
+    extra_metadata: Optional[dict] = None,
+) -> None:
+    """saveGameModelToHDFS role (ModelProcessingUtils.scala:77-131)."""
+    entity_indexes = entity_indexes or {}
+    os.makedirs(output_dir, exist_ok=True)
+    meta: dict = {"coordinates": {}, **(extra_metadata or {})}
+
+    for cid, sub in model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            cdir = os.path.join(output_dir, FIXED_DIR, cid, COEFF_DIR)
+            os.makedirs(cdir, exist_ok=True)
+            imap = index_maps[sub.feature_shard]
+            rec = _coeffs_to_avro(
+                cid,
+                np.asarray(sub.model.coefficients.means),
+                None
+                if sub.model.coefficients.variances is None
+                else np.asarray(sub.model.coefficients.variances),
+                imap,
+                sub.model.task,
+                sparsity_threshold,
+            )
+            write_avro_records(
+                os.path.join(cdir, "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL_SCHEMA,
+                [rec],
+            )
+            meta["coordinates"][cid] = {
+                "type": "fixed",
+                "featureShard": sub.feature_shard,
+                "task": sub.model.task.value,
+                "dim": int(sub.model.coefficients.dim),
+            }
+        elif isinstance(sub, RandomEffectModel):
+            cdir = os.path.join(output_dir, RANDOM_DIR, cid)
+            os.makedirs(cdir, exist_ok=True)
+            with open(os.path.join(cdir, ID_INFO_FILE), "w") as f:
+                f.write(sub.re_type)
+            imap = index_maps[sub.feature_shard]
+            eidx = entity_indexes.get(sub.re_type)
+            coefs = np.asarray(sub.coefficients)
+            variances = None if sub.variances is None else np.asarray(sub.variances)
+            records = []
+            for e in range(coefs.shape[0]):
+                model_id = eidx.entity_id(e) if eidx is not None else str(e)
+                records.append(
+                    _coeffs_to_avro(
+                        model_id,
+                        coefs[e],
+                        None if variances is None else variances[e],
+                        imap,
+                        sub.task,
+                        sparsity_threshold,
+                    )
+                )
+            write_avro_records(
+                os.path.join(cdir, "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL_SCHEMA,
+                records,
+            )
+            meta["coordinates"][cid] = {
+                "type": "random",
+                "reType": sub.re_type,
+                "featureShard": sub.feature_shard,
+                "task": sub.task.value,
+                "dim": int(coefs.shape[1]),
+                "numEntities": int(coefs.shape[0]),
+            }
+        else:
+            raise TypeError(f"unknown submodel type {type(sub)}")
+
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_game_model(
+    model_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+) -> GameModel:
+    """loadGameModelFromHDFS role (ModelProcessingUtils.scala:143+). Entity
+    ids are re-interned against the provided EntityIndex (or a fresh one),
+    so warm starts align with the new run's interning."""
+    entity_indexes = entity_indexes if entity_indexes is not None else {}
+    with open(os.path.join(model_dir, METADATA_FILE)) as f:
+        meta = json.load(f)
+
+    models: Dict[str, object] = {}
+    for cid, info in meta["coordinates"].items():
+        task = TaskType(info["task"])
+        shard = info["featureShard"]
+        imap = index_maps[shard]
+        dim = info.get("dim", len(imap))
+        if info["type"] == "fixed":
+            path = os.path.join(model_dir, FIXED_DIR, cid, COEFF_DIR, "part-00000.avro")
+            (rec,) = read_avro_records(path)
+            means, variances, _ = _avro_to_coeffs(rec, imap, dim)
+            models[cid] = FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(
+                        jnp.asarray(means),
+                        None if variances is None else jnp.asarray(variances),
+                    ),
+                    task,
+                ),
+                shard,
+            )
+        else:
+            cdir = os.path.join(model_dir, RANDOM_DIR, cid)
+            with open(os.path.join(cdir, ID_INFO_FILE)) as f:
+                re_type = f.read().strip()
+            eidx = entity_indexes.setdefault(re_type, EntityIndex())
+            recs = []
+            for fn in sorted(os.listdir(cdir)):
+                if fn.endswith(".avro"):
+                    recs.extend(read_avro_records(os.path.join(cdir, fn)))
+            # First pass: intern all entity ids.
+            for rec in recs:
+                eidx.intern(rec["modelId"])
+            E = len(eidx)
+            coefs = np.zeros((E, dim), np.float32)
+            variances_arr = None
+            for rec in recs:
+                e = eidx.lookup(rec["modelId"])
+                means, variances, _ = _avro_to_coeffs(rec, imap, dim)
+                coefs[e] = means
+                if variances is not None:
+                    if variances_arr is None:
+                        variances_arr = np.zeros((E, dim), np.float32)
+                    variances_arr[e] = variances
+            models[cid] = RandomEffectModel(
+                jnp.asarray(coefs),
+                re_type,
+                shard,
+                task,
+                None if variances_arr is None else jnp.asarray(variances_arr),
+            )
+    return GameModel(models)

@@ -13,8 +13,11 @@ single jitted program, so the ≤20 H·v products per outer iteration that cost
 the reference ≤20 treeAggregate rounds (TRON.scala:287-326) cost zero host
 round-trips here.
 
-The trust-region constants below are the standard published LIBLINEAR values
-(eta0=1e-4, eta1=0.25, eta2=0.75, sigma1=0.25, sigma2=0.5, sigma3=4).
+Trust-region constants: acceptance/band thresholds eta0=1e-4, eta1=0.25,
+eta2=0.75 and shrink/grow factors sigma1=0.25, sigma3=4 (standard published
+values). Unlike LIBLINEAR's exact radius schedule, the middle band
+(eta1 <= rho < eta2) keeps the radius unchanged — the textbook TR update —
+which avoids the geometric shrink that stalls runs whose rho hovers there.
 """
 
 from __future__ import annotations
@@ -38,7 +41,7 @@ ValueAndGrad = Callable[[Array], Tuple[Array, Array]]
 Hvp = Callable[[Array, Array], Array]
 
 ETA0, ETA1, ETA2 = 1e-4, 0.25, 0.75
-SIGMA1, SIGMA2, SIGMA3 = 0.25, 0.5, 4.0
+SIGMA1, SIGMA3 = 0.25, 4.0
 
 TRON_DEFAULT_CONFIG = OptimizerConfig(max_iter=15, tol=1e-5)
 

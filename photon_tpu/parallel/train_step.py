@@ -30,44 +30,6 @@ from photon_tpu.parallel.mesh import DATA_AXIS
 Array = jax.Array
 
 
-def fixed_effect_step(
-    objective: GLMObjective, config: OptimizerConfig
-):
-    """Returns jitted (w0, batch) -> (w, value, iterations): a full L-BFGS
-    optimize of the fixed-effect coordinate as ONE XLA program."""
-
-    @jax.jit
-    def step(w0: Array, batch: LabeledBatch):
-        res = minimize_lbfgs(
-            lambda w: objective.value_and_grad(w, batch), w0, config
-        )
-        return res.w, res.value, res.iterations
-
-    return step
-
-
-def random_effect_step(
-    objective: GLMObjective, config: OptimizerConfig
-):
-    """Returns jitted (w0_block, block, offsets) -> (E, d) coefficients:
-    vmapped per-entity L-BFGS over one entity block."""
-
-    @jax.jit
-    def step(w0: Array, block: EntityBlock, offsets: Array):
-        def solve_one(feat, lab, wt, off, w_init):
-            lb = LabeledBatch(lab, feat, off, wt)
-            res = minimize_lbfgs(
-                lambda w: objective.value_and_grad(w, lb), w_init, config
-            )
-            return res.w
-
-        return jax.vmap(solve_one)(
-            block.features, block.label, block.weight, offsets, w0
-        )
-
-    return step
-
-
 def glmix_train_step(
     fixed_objective: GLMObjective,
     re_objective: GLMObjective,
@@ -87,7 +49,15 @@ def glmix_train_step(
     Also returns exact work counters for throughput accounting:
     ``fe_evals`` (fixed-effect objective evaluations incl. line search) and
     ``re_sample_visits`` (Σ_e evals_e × n_e over entities).
+
+    Smooth objectives only: L1/elastic-net training routes through the
+    coordinate-descent path (OWL-QN); see photon_tpu.algorithm.
     """
+    if fixed_objective.l1_weight > 0.0 or re_objective.l1_weight > 0.0:
+        raise ValueError(
+            "glmix_train_step solves smooth objectives (L-BFGS); use the "
+            "coordinate-descent path for L1/elastic-net (OWL-QN routing)"
+        )
 
     def step(
         w_fixed: Array,
@@ -124,10 +94,13 @@ def glmix_train_step(
             )
             return res.w, res.evals
 
+        w_init = re_coefs[re_block.entity_idx]
         w_new, re_evals = jax.vmap(solve_one)(
-            re_block.features, re_block.label, re_block.weight, offs,
-            re_coefs[re_block.entity_idx],
+            re_block.features, re_block.label, re_block.weight, offs, w_init,
         )
+        # Entities under the active_lower_bound filter keep their existing
+        # model (EntityBlock.train_mask contract, data/random_effect.py).
+        w_new = jnp.where(re_block.train_mask[:, None], w_new, w_init)
         re_coefs_new = re_coefs.at[re_block.entity_idx].set(w_new)
         re_sample_visits = jnp.sum(
             re_evals * jnp.sum((re_block.weight > 0).astype(jnp.int32), axis=1)

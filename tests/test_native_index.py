@@ -1,0 +1,66 @@
+"""Native mmap index store: build + native/pure readers round trip.
+
+Mirrors the reference's PalDB index tests (FeatureIndexingDriverIntegTest
+round-trip of partitioned stores).
+"""
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.data.native_index import (
+    NativeIndexMap,
+    NativeIndexMapBuilder,
+    build_native_lib,
+)
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    d = tmp_path_factory.mktemp("idx")
+    imap = IndexMap.build([f"feat{i}\x01term{i % 3}" for i in range(1000)], add_intercept=True)
+    NativeIndexMapBuilder(str(d), num_partitions=4).build(imap)
+    return str(d), imap
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_round_trip(store, use_native):
+    d, imap = store
+    nim = NativeIndexMap(d, use_native=use_native)
+    if use_native and not nim.is_native:
+        pytest.skip("native toolchain unavailable")
+    assert len(nim) == len(imap)
+    for key, idx in list(imap.items())[:200]:
+        assert nim.get_index(key) == idx
+        assert nim.get_feature_name(idx) == key
+    assert nim.get_index("not-a-feature") == -1
+    assert nim.get_feature_name(len(imap) + 5) is None
+    nim.close()
+
+
+def test_batched_lookup_native(store):
+    d, imap = store
+    nim = NativeIndexMap(d, use_native=True)
+    if not nim.is_native:
+        pytest.skip("native toolchain unavailable")
+    keys = [k for k, _ in list(imap.items())[:500]] + ["missing1", "missing2"]
+    vals = nim.get_indices(keys)
+    expected = np.array([imap.get_index(k) for k in keys], np.int64)
+    np.testing.assert_array_equal(vals, expected)
+    nim.close()
+
+
+def test_native_lib_builds():
+    assert build_native_lib() is not None
+
+
+def test_native_and_pure_agree(store):
+    d, _ = store
+    native = NativeIndexMap(d, use_native=True)
+    pure = NativeIndexMap(d, use_native=False)
+    if not native.is_native:
+        pytest.skip("native toolchain unavailable")
+    for key in [f"feat{i}\x01term{i % 3}" for i in range(0, 1000, 37)]:
+        assert native.get_index(key) == pure.get_index(key)
+    native.close()
+    pure.close()
