@@ -112,7 +112,30 @@ def add_common_args(p: argparse.ArgumentParser) -> None:
                    help="name=<shard>,feature.bags=a|b,intercept=true")
     p.add_argument("--task", default="LOGISTIC_REGRESSION",
                    choices=[t.name for t in TaskType])
+    p.add_argument("--input-data-date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd over daily-format input dirs "
+                        "(reference inputDataDateRange)")
+    p.add_argument("--input-data-days-range", default=None,
+                   help="start-end days ago (reference inputDataDaysRange)")
+    p.add_argument("--override-output-dir", action="store_true")
     p.add_argument("--verbose", action="store_true")
+
+
+def add_validation_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=["VALIDATE_FULL", "VALIDATE_SAMPLE", "VALIDATE_DISABLED"],
+                   help="row-level sanity checks (reference DataValidators)")
+
+
+def resolve_input_paths(args) -> list:
+    """Expand --input-paths through any date/days range (IOUtils role)."""
+    from photon_tpu.utils.io_utils import date_range_from_specs, resolve_range_paths
+
+    date_range = date_range_from_specs(
+        getattr(args, "input_data_date_range", None),
+        getattr(args, "input_data_days_range", None),
+    )
+    return resolve_range_paths(args.input_paths, date_range)
 
 
 def task_of(args) -> TaskType:

@@ -47,6 +47,9 @@ from photon_tpu.utils.timed import Timed
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("game-training")
     add_common_args(p)
+    from photon_tpu.cli.common import add_validation_arg
+
+    add_validation_arg(p)
     p.add_argument("--validation-paths", nargs="*", default=None)
     p.add_argument("--coordinate-configurations", nargs="+", required=True)
     p.add_argument("--update-sequence", required=True,
@@ -81,10 +84,20 @@ def run(args) -> Dict:
         if hasattr(c, "re_type")
     }
 
+    from photon_tpu.cli.common import resolve_input_paths
+    from photon_tpu.data.validators import DataValidationType, validate_game_batch
+    from photon_tpu.utils.io_utils import process_output_dir
+
+    process_output_dir(args.output_dir, args.override_output_dir)
     with Timed("driver/read-train"):
         batch, index_maps, entity_indexes = read_merged(
-            args.input_paths, shard_configs, entity_id_columns=entity_id_columns
+            resolve_input_paths(args), shard_configs,
+            entity_id_columns=entity_id_columns,
         )
+    # Row-level sanity checks on train + validation data
+    # (GameTrainingDriver.scala:415-432).
+    validation_mode = DataValidationType[args.data_validation]
+    validate_game_batch(batch, task, validation_mode)
     valid_batch = None
     if args.validation_paths:
         with Timed("driver/read-validation"):
@@ -93,6 +106,7 @@ def run(args) -> Dict:
                 entity_id_columns=entity_id_columns, entity_indexes=entity_indexes,
                 intern_new_entities=False,
             )
+        validate_game_batch(valid_batch, task, validation_mode)
 
     # Feature stats + normalization per shard (GameTrainingDriver.scala:434-440).
     intercept_indices = {

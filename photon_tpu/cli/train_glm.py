@@ -81,6 +81,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-variance", action="store_true")
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="dotted paths of event listener callables")
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=["VALIDATE_FULL", "VALIDATE_SAMPLE", "VALIDATE_DISABLED"],
+                   help="row-level sanity checks (reference DataValidators)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -114,6 +117,12 @@ def run(args) -> Dict:
 
     train, imap = _load(args, args.training_data)
     valid, _ = _load(args, args.validation_data, imap)
+    from photon_tpu.data.validators import DataValidationType, validate_labeled_batch
+
+    validation_mode = DataValidationType[args.data_validation]
+    validate_labeled_batch(train, task, validation_mode)
+    if valid is not None:
+        validate_labeled_batch(valid, task, validation_mode)
     icpt = imap.get_index(IndexMap.INTERCEPT) if args.intercept else None
     if icpt is not None and icpt < 0:
         icpt = None

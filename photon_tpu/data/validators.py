@@ -1,0 +1,129 @@
+"""Row-level training-data sanity checks.
+
+Parity target: reference ``DataValidators`` (photon-client
+data/DataValidators.scala): per-row checks — finite features, label in the
+task's domain, non-negative weights, finite offsets — with validation modes
+VALIDATE_FULL / VALIDATE_SAMPLE / VALIDATE_DISABLED, raising on the first
+violated predicate.
+
+TPU-first design: the checks are whole-array reductions on the
+struct-of-arrays batch (one vectorized pass instead of a per-row Spark
+filter); VALIDATE_SAMPLE checks a deterministic stride subsample.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+import numpy as np
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.types import TaskType
+
+
+class DataValidationType(enum.Enum):
+    """How much of the data to validate (reference DataValidators modes)."""
+
+    VALIDATE_FULL = "VALIDATE_FULL"
+    VALIDATE_SAMPLE = "VALIDATE_SAMPLE"
+    VALIDATE_DISABLED = "VALIDATE_DISABLED"
+
+
+class DataValidationError(ValueError):
+    """Raised when training data fails a sanity check."""
+
+
+_SAMPLE_TARGET = 10_000
+
+
+def _subsample(a: np.ndarray, mode: DataValidationType) -> np.ndarray:
+    if mode != DataValidationType.VALIDATE_SAMPLE or a.shape[0] <= _SAMPLE_TARGET:
+        return a
+    stride = max(1, a.shape[0] // _SAMPLE_TARGET)
+    return a[::stride]
+
+def _check_finite(name: str, a: np.ndarray, errors: List[str]) -> None:
+    if not np.all(np.isfinite(a)):
+        errors.append(f"{name} contains non-finite values")
+
+
+def _check_labels(task: TaskType, y: np.ndarray, errors: List[str]) -> None:
+    """Label-domain predicate per task (DataValidators label checks)."""
+    if task == TaskType.LOGISTIC_REGRESSION or task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        # Binary labels: 0/1 (the ±1 mapping happens inside the loss).
+        ok = np.all((y == 0.0) | (y == 1.0))
+        if not ok:
+            errors.append(f"{task.value} requires binary labels in {{0, 1}}")
+    elif task == TaskType.POISSON_REGRESSION:
+        if not np.all(y >= 0.0):
+            errors.append("POISSON_REGRESSION requires non-negative labels")
+    else:  # LINEAR_REGRESSION: any finite label
+        _check_finite("labels", y, errors)
+
+
+def validate_labeled_batch(
+    batch: LabeledBatch,
+    task: TaskType,
+    mode: DataValidationType = DataValidationType.VALIDATE_FULL,
+) -> None:
+    """Sanity-check one single-shard batch; raises DataValidationError.
+
+    Mirrors DataValidators.sanityCheckData for the legacy driver path.
+    """
+    if mode == DataValidationType.VALIDATE_DISABLED:
+        return
+    errors: List[str] = []
+    y = _subsample(np.asarray(batch.label), mode)
+    _check_finite("labels", y, errors)
+    if not errors:
+        _check_labels(task, y, errors)
+    if batch.weight is not None:
+        w = _subsample(np.asarray(batch.weight), mode)
+        _check_finite("weights", w, errors)
+        if not np.all(np.asarray(w) >= 0.0):
+            errors.append("weights must be non-negative")
+    if batch.offset is not None:
+        _check_finite("offsets", _subsample(np.asarray(batch.offset), mode), errors)
+    feats = batch.features
+    if isinstance(feats, SparseFeatures):
+        _check_finite("features", _subsample(np.asarray(feats.values), mode), errors)
+    else:
+        _check_finite("features", _subsample(np.asarray(feats), mode), errors)
+    if errors:
+        raise DataValidationError("; ".join(errors))
+
+
+def validate_game_batch(
+    batch: GameBatch,
+    task: TaskType,
+    mode: DataValidationType = DataValidationType.VALIDATE_FULL,
+    feature_shards: Optional[List[str]] = None,
+) -> None:
+    """Sanity-check a GAME batch across all (or the given) feature shards.
+
+    Mirrors DataValidators.sanityCheckDataFrameForTraining
+    (GameTrainingDriver.scala:415-432 call site).
+    """
+    if mode == DataValidationType.VALIDATE_DISABLED:
+        return
+    errors: List[str] = []
+    y = _subsample(np.asarray(batch.label), mode)
+    _check_finite("labels", y, errors)
+    if not errors:
+        _check_labels(task, y, errors)
+    w = _subsample(np.asarray(batch.weight), mode)
+    _check_finite("weights", w, errors)
+    if not np.all(w >= 0.0):
+        errors.append("weights must be non-negative")
+    _check_finite("offsets", _subsample(np.asarray(batch.offset), mode), errors)
+    for shard in feature_shards or list(batch.features):
+        feats = batch.features[shard]
+        if isinstance(feats, SparseFeatures):
+            vals = np.asarray(feats.values)
+        else:
+            vals = np.asarray(feats)
+        _check_finite(f"features[{shard}]", _subsample(vals, mode), errors)
+    if errors:
+        raise DataValidationError("; ".join(errors))

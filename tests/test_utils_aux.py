@@ -1,0 +1,247 @@
+"""Tests for auxiliary driver utilities: data validators, date ranges,
+name-and-term feature bags, search-range shrinking, driver logger.
+
+Mirrors reference DataValidators tests, DateRange/DaysRange/IOUtils tests,
+NameAndTermFeatureMapUtils round trips, and ShrinkSearchRange behavior.
+"""
+
+import datetime
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.validators import (
+    DataValidationError,
+    DataValidationType,
+    validate_game_batch,
+    validate_labeled_batch,
+)
+from photon_tpu.types import TaskType
+from photon_tpu.utils.io_utils import (
+    DateRange,
+    DaysRange,
+    PhotonLogger,
+    process_output_dir,
+    read_text,
+    resolve_range_paths,
+    write_text,
+)
+
+rng = np.random.default_rng(3)
+
+
+def _batch(y, X=None, w=None, off=None):
+    n = len(y)
+    X = rng.normal(size=(n, 3)).astype(np.float32) if X is None else X
+    return LabeledBatch(
+        jnp.asarray(np.asarray(y, np.float32)),
+        jnp.asarray(X),
+        None if off is None else jnp.asarray(np.asarray(off, np.float32)),
+        None if w is None else jnp.asarray(np.asarray(w, np.float32)),
+    )
+
+
+class TestValidators:
+    def test_valid_logistic_passes(self):
+        validate_labeled_batch(_batch([0, 1, 1, 0]), TaskType.LOGISTIC_REGRESSION)
+
+    def test_bad_binary_label_fails(self):
+        with pytest.raises(DataValidationError, match="binary"):
+            validate_labeled_batch(_batch([0, 2.0]), TaskType.LOGISTIC_REGRESSION)
+
+    def test_negative_poisson_label_fails(self):
+        with pytest.raises(DataValidationError, match="non-negative"):
+            validate_labeled_batch(_batch([1.0, -1.0]), TaskType.POISSON_REGRESSION)
+
+    def test_nonfinite_feature_fails(self):
+        X = np.ones((2, 3), np.float32)
+        X[1, 2] = np.nan
+        with pytest.raises(DataValidationError, match="features"):
+            validate_labeled_batch(_batch([0, 1], X), TaskType.LOGISTIC_REGRESSION)
+
+    def test_negative_weight_fails(self):
+        with pytest.raises(DataValidationError, match="weights"):
+            validate_labeled_batch(
+                _batch([0, 1], w=[1.0, -2.0]), TaskType.LOGISTIC_REGRESSION
+            )
+
+    def test_nonfinite_label_linear_fails(self):
+        with pytest.raises(DataValidationError):
+            validate_labeled_batch(_batch([1.0, np.inf]), TaskType.LINEAR_REGRESSION)
+
+    def test_disabled_skips_bad_data(self):
+        validate_labeled_batch(
+            _batch([0, 5.0]), TaskType.LOGISTIC_REGRESSION,
+            DataValidationType.VALIDATE_DISABLED,
+        )
+
+    def test_sample_mode_on_clean_data(self):
+        validate_labeled_batch(
+            _batch(np.zeros(100)), TaskType.LOGISTIC_REGRESSION,
+            DataValidationType.VALIDATE_SAMPLE,
+        )
+
+    def test_game_batch_sparse_shard(self):
+        n = 4
+        sp = SparseFeatures(
+            jnp.zeros((n, 2), jnp.int32), jnp.ones((n, 2), jnp.float32), dim=5
+        )
+        gb = GameBatch(
+            label=jnp.asarray(np.array([0, 1, 0, 1], np.float32)),
+            offset=jnp.zeros(n),
+            weight=jnp.ones(n),
+            features={"s": sp},
+            entity_ids={},
+        )
+        validate_game_batch(gb, TaskType.LOGISTIC_REGRESSION)
+
+    def test_game_batch_bad_offset(self):
+        n = 2
+        gb = GameBatch(
+            label=jnp.asarray(np.array([0, 1], np.float32)),
+            offset=jnp.asarray(np.array([0.0, np.nan], np.float32)),
+            weight=jnp.ones(n),
+            features={"s": jnp.ones((n, 2))},
+            entity_ids={},
+        )
+        with pytest.raises(DataValidationError, match="offsets"):
+            validate_game_batch(gb, TaskType.LOGISTIC_REGRESSION)
+
+
+class TestDateRanges:
+    def test_parse_and_dates(self):
+        r = DateRange.parse("20170101-20170103")
+        assert [d.day for d in r.dates()] == [1, 2, 3]
+        assert str(r) == "20170101-20170103"
+
+    def test_invalid_order(self):
+        with pytest.raises(ValueError):
+            DateRange.parse("20170102-20170101")
+
+    def test_unparseable(self):
+        with pytest.raises(ValueError, match="date range"):
+            DateRange.parse("2017-01-01")
+
+    def test_days_range(self):
+        today = datetime.date(2017, 1, 10)
+        r = DaysRange.parse("9-7").to_date_range(today)
+        assert r.start == datetime.date(2017, 1, 1)
+        assert r.end == datetime.date(2017, 1, 3)
+
+    def test_days_range_invalid(self):
+        with pytest.raises(ValueError):
+            DaysRange.parse("3-5")
+
+    def test_resolve_range_paths(self, tmp_path):
+        base = tmp_path / "train"
+        for day in (1, 2, 4):
+            (base / "daily" / "2017" / "01" / f"{day:02d}").mkdir(parents=True)
+        got = resolve_range_paths([str(base)], DateRange.parse("20170101-20170103"))
+        assert [os.path.basename(p) for p in got] == ["01", "02"]
+
+    def test_resolve_no_range_passthrough(self):
+        assert resolve_range_paths(["a", "b"], None) == ["a", "b"]
+
+    def test_resolve_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            resolve_range_paths([str(tmp_path)], DateRange.parse("20170101-20170101"))
+
+
+class TestIoUtils:
+    def test_output_dir_lifecycle(self, tmp_path):
+        out = tmp_path / "out"
+        process_output_dir(str(out), override=False)
+        assert out.is_dir()
+        # Existing but empty dir is fine without override.
+        process_output_dir(str(out), override=False)
+        (out / "junk").write_text("x")
+        with pytest.raises(FileExistsError):
+            process_output_dir(str(out), override=False)
+        process_output_dir(str(out), override=True)
+        assert not (out / "junk").exists()
+
+    def test_text_round_trip(self, tmp_path):
+        p = str(tmp_path / "t.txt")
+        write_text(p, ["a", "b c"])
+        assert read_text(p) == ["a", "b c"]
+
+    def test_photon_logger_writes_file(self, tmp_path):
+        with PhotonLogger(str(tmp_path)) as log:
+            log.info("hello world")
+        content = open(log.path).read()
+        assert "hello world" in content
+
+
+class TestNameAndTermBags:
+    def test_round_trip_and_index_map(self, tmp_path):
+        from photon_tpu.cli.name_and_term_bags import (
+            index_map_from_text_bags,
+            load_name_and_terms,
+            save_name_and_terms,
+        )
+
+        out = str(tmp_path)
+        save_name_and_terms(out, "bagA", {("f1", "t1"), ("f2", "")})
+        save_name_and_terms(out, "bagB", {("f3", "t3")})
+        assert load_name_and_terms(out, "bagA") == [("f1", "t1"), ("f2", "")]
+        imap = index_map_from_text_bags(out, ["bagA", "bagB"], add_intercept=True)
+        assert len(imap) == 4  # 3 features + intercept
+
+    def test_driver_end_to_end(self, tmp_path):
+        from photon_tpu.cli.name_and_term_bags import build_parser, load_name_and_terms, run
+        from photon_tpu.io.avro import write_avro_records
+        from photon_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+        data = str(tmp_path / "data.avro")
+        records = [
+            {
+                "label": 1.0,
+                "features": [
+                    {"name": "a", "term": "x", "value": 1.0},
+                    {"name": "b", "term": "", "value": 2.0},
+                ],
+            },
+            {
+                "label": 0.0,
+                "features": [{"name": "a", "term": "x", "value": 3.0}],
+            },
+        ]
+        write_avro_records(data, TRAINING_EXAMPLE_SCHEMA, records)
+        out = str(tmp_path / "bags")
+        args = build_parser().parse_args([
+            "--input-data-directories", data,
+            "--root-output-directory", out,
+            "--feature-bags-keys", "features",
+        ])
+        counts = run(args)
+        assert counts == {"features": 2}
+        assert set(load_name_and_terms(out, "features")) == {("a", "x"), ("b", "")}
+
+
+class TestShrinkSearchRange:
+    def test_shrinks_around_best(self):
+        from photon_tpu.hyperparameter.search import SearchRange
+        from photon_tpu.hyperparameter.shrink import shrink_search_range
+
+        sr = SearchRange(np.array([0.0, -10.0]), np.array([10.0, 10.0]))
+        # Quadratic bowl with minimum at (2, 1).
+        obs = []
+        g = np.random.default_rng(0)
+        for _ in range(25):
+            x = sr.rescale(g.uniform(size=(1, 2)))[0]
+            obs.append((x, float((x[0] - 2.0) ** 2 + (x[1] - 1.0) ** 2)))
+        shrunk = shrink_search_range(obs, sr, radius=0.2, candidate_pool_size=256, seed=0)
+        # The shrunk box is strictly smaller and contains a near-optimal point.
+        assert np.all(shrunk.upper - shrunk.lower < sr.upper - sr.lower)
+        assert shrunk.lower[0] <= 2.0 + 2.0 and shrunk.upper[0] >= 2.0 - 2.0
+
+    def test_empty_prior_is_identity(self):
+        from photon_tpu.hyperparameter.search import SearchRange
+        from photon_tpu.hyperparameter.shrink import shrink_search_range
+
+        sr = SearchRange(np.zeros(2), np.ones(2))
+        assert shrink_search_range([], sr, 0.1) is sr
