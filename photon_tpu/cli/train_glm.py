@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.cli.common import setup_logging, task_of
+from photon_tpu.cli.common import add_validation_arg, setup_logging, task_of
 from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.index_map import IndexMap
 from photon_tpu.data.normalization import build_normalization_context
@@ -81,9 +81,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-variance", action="store_true")
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="dotted paths of event listener callables")
-    p.add_argument("--data-validation", default="VALIDATE_FULL",
-                   choices=["VALIDATE_FULL", "VALIDATE_SAMPLE", "VALIDATE_DISABLED"],
-                   help="row-level sanity checks (reference DataValidators)")
+    add_validation_arg(p)
     p.add_argument("--verbose", action="store_true")
     return p
 

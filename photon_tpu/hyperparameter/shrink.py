@@ -24,7 +24,7 @@ def shrink_search_range(
     prior_observations: Sequence[Tuple[np.ndarray, float]],
     search_range: SearchRange,
     radius: float,
-    candidate_pool_size: int = 1000,
+    candidate_pool_size: int = 1024,
     seed: int = 1,
     estimator: Optional[GaussianProcessEstimator] = None,
 ) -> SearchRange:

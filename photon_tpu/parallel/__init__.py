@@ -1,2 +1,8 @@
 from photon_tpu.parallel.mesh import make_mesh, DATA_AXIS, ENTITY_AXIS, FEATURE_AXIS  # noqa: F401
 from photon_tpu.parallel.distributed import shard_batch, replicate  # noqa: F401
+from photon_tpu.parallel.feature_sharded import (  # noqa: F401
+    padded_dim,
+    place_feature_sharded,
+    sparse_value_and_grad_feature_sharded,
+    train_fixed_effect_feature_sharded,
+)

@@ -1,0 +1,226 @@
+"""Feature-dimension-sharded fixed-effect training (the TP analogue).
+
+Parity target: the reference's answer to coefficient vectors too large for
+one machine — sparse Breeze vectors plus the off-heap PalDB feature index
+(photon-api index/PalDBIndexMap.scala:43-240) so "hundreds of billions of
+coefficients" (README.md:56) never materialize on the driver. The TPU
+analogue (SURVEY.md §2.7/§5): shard ``w`` and its gradient over the mesh's
+``feature`` axis so a single fixed-effect coordinate can exceed one chip's
+HBM.
+
+Design (shard_map over a (data, feature) mesh):
+
+- Each device along ``feature`` owns a contiguous coefficient range
+  ``[lo, lo + d/F)`` of the global dimension; ``w`` lives sharded
+  ``P('feature')`` and is never gathered.
+- Sparse batches keep GLOBAL feature indices, rows sharded ``P('data')`` and
+  replicated along ``feature``. Each device resolves only the indices that
+  land in its range (mask + local gather); partial margins are psummed over
+  ``feature`` — a (n_local,) all-reduce on ICI instead of an all-gather of a
+  10B-coefficient vector.
+- The gradient is scatter-added into the LOCAL coefficient range (each device
+  owns its features outright) and psummed over ``data`` only — the same
+  reduction Spark's treeAggregate performs, minus the driver round-trip.
+
+L-BFGS runs unchanged on top: its two-loop recursion is built from dots and
+axpys over (m, d) history arrays which XLA partitions along ``feature``
+automatically once ``w`` is sharded (history inherits the sharding; the dots
+become psums on ICI).
+
+Normalization: scale ``factors`` fold in (a local gather, like values);
+``shifts`` densify sparse rows (reference hits the same wall —
+HessianMatrixAggregator.scala:27-28) and are rejected.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.common import OptimizeResult, OptimizerConfig
+from photon_tpu.optim.lbfgs import minimize_lbfgs
+from photon_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
+
+Array = jax.Array
+
+
+def padded_dim(dim: int, n_feature_shards: int) -> int:
+    """Global coefficient dim padded so every feature shard is equal-sized.
+    Padded coefficients start at 0, receive zero data gradient and zero L2
+    gradient, and therefore stay exactly 0 through any quasi-Newton run."""
+    f = n_feature_shards
+    return int(np.ceil(dim / f) * f)
+
+
+def _check_objective(objective: GLMObjective) -> None:
+    norm = objective.normalization
+    if norm is not None and norm.shifts is not None:
+        raise ValueError(
+            "feature-sharded training supports scale normalization only: "
+            "shift normalization densifies sparse rows (same limitation the "
+            "reference documents in HessianMatrixAggregator.scala:27-28); "
+            "standardize to scale-only or use the replicated path"
+        )
+
+
+def sparse_value_and_grad_feature_sharded(
+    objective: GLMObjective, mesh: Mesh, dim: int
+):
+    """Build ``(w, batch) -> (value, grad)`` for a sparse LabeledBatch with
+    ``w`` sharded over FEATURE_AXIS and rows sharded over DATA_AXIS.
+
+    ``dim`` is the PADDED global dimension (a multiple of the feature-axis
+    size). The returned function is jittable; ``batch.features`` must be
+    SparseFeatures carrying global indices.
+    """
+    _check_objective(objective)
+    n_feat = mesh.shape[FEATURE_AXIS]
+    assert dim % n_feat == 0, f"dim {dim} not divisible by feature axis {n_feat}"
+    shard = dim // n_feat
+    loss = objective.loss
+    l2 = objective.l2_weight
+    intercept = objective.intercept_index
+    factors = None if objective.normalization is None else objective.normalization.factors
+
+    def local_fn(w_loc, indices, values, label, offset, weight, factors_loc):
+        """Runs per device: w_loc (shard,), rows local along data."""
+        lo = jax.lax.axis_index(FEATURE_AXIS) * shard
+        local_idx = indices - lo
+        valid = (local_idx >= 0) & (local_idx < shard)
+        local_idx = jnp.clip(local_idx, 0, shard - 1)
+
+        vals = values
+        if factors_loc is not None:
+            f_gather = jnp.where(valid, factors_loc[local_idx], 0.0)
+            vals = vals * f_gather
+
+        gathered = jnp.where(valid, w_loc[local_idx], 0.0)
+        z_partial = jnp.sum(vals * gathered, axis=-1)
+        z = jax.lax.psum(z_partial, FEATURE_AXIS) + offset
+
+        lv = loss.value(z, label)
+        dz = weight * loss.dz(z, label)
+        loss_local = jnp.sum(weight * lv)
+
+        # Scatter-add into the local coefficient range only.
+        contrib = jnp.where(valid, vals * dz[:, None], 0.0)
+        grad_loc = jnp.zeros((shard,), values.dtype).at[
+            local_idx.reshape(-1)
+        ].add(contrib.reshape(-1))
+        grad_loc = jax.lax.psum(grad_loc, DATA_AXIS)
+
+        # L2 on the local shard; the (global) intercept is exempt.
+        if l2 != 0.0:
+            wm = w_loc
+            if intercept is not None:
+                pos = jnp.arange(shard) + lo
+                wm = jnp.where(pos == intercept, 0.0, wm)
+            grad_loc = grad_loc + l2 * wm
+            l2_local = 0.5 * l2 * jnp.sum(wm * wm)
+        else:
+            l2_local = jnp.zeros((), values.dtype)
+
+        value = jax.lax.pmean(
+            jax.lax.psum(loss_local, DATA_AXIS), FEATURE_AXIS
+        ) + jax.lax.pmean(jax.lax.psum(l2_local, FEATURE_AXIS), DATA_AXIS)
+        return value, grad_loc
+
+    in_specs = (
+        P(FEATURE_AXIS),          # w
+        P(DATA_AXIS, None),       # indices
+        P(DATA_AXIS, None),       # values
+        P(DATA_AXIS),             # label
+        P(DATA_AXIS),             # offset
+        P(DATA_AXIS),             # weight
+    )
+    factor_spec = (P(FEATURE_AXIS),) if factors is not None else ()
+    shmapped = jax.shard_map(
+        (lambda w, i, v, y, o, wt, f: local_fn(w, i, v, y, o, wt, f))
+        if factors is not None
+        else (lambda w, i, v, y, o, wt: local_fn(w, i, v, y, o, wt, None)),
+        mesh=mesh,
+        in_specs=in_specs + factor_spec,
+        out_specs=(P(), P(FEATURE_AXIS)),
+    )
+
+    def value_and_grad(w: Array, batch: LabeledBatch) -> Tuple[Array, Array]:
+        feats = batch.features
+        assert isinstance(feats, SparseFeatures)
+        args = (w, feats.indices, feats.values, batch.label, batch.offset, batch.weight)
+        if factors is not None:
+            args = args + (factors,)
+        return shmapped(*args)
+
+    return value_and_grad
+
+
+def place_feature_sharded(
+    mesh: Mesh, w: Array, batch: LabeledBatch
+) -> Tuple[Array, LabeledBatch]:
+    """device_put ``w`` P('feature') and the sparse batch rows P('data')."""
+    wsh = NamedSharding(mesh, P(FEATURE_AXIS))
+    rows = NamedSharding(mesh, P(DATA_AXIS))
+    rows2d = NamedSharding(mesh, P(DATA_AXIS, None))
+    feats = batch.features
+    assert isinstance(feats, SparseFeatures)
+    put = jax.device_put
+    feats = SparseFeatures(put(feats.indices, rows2d), put(feats.values, rows2d), feats.dim)
+    placed = LabeledBatch(
+        label=put(batch.label, rows),
+        features=feats,
+        offset=put(batch.offset, rows),
+        weight=put(batch.weight, rows),
+        uid=None if batch.uid is None else put(batch.uid, rows),
+    )
+    return put(w, wsh), placed
+
+
+def train_fixed_effect_feature_sharded(
+    mesh: Mesh,
+    objective: GLMObjective,
+    config: OptimizerConfig,
+    dim: int,
+    box: Optional[Tuple[Array, Array]] = None,
+):
+    """Jitted L-BFGS fit of a sparse fixed-effect coordinate with ``w``
+    feature-sharded over the mesh (reference FixedEffectCoordinate.trainModel
+    role, FixedEffectCoordinate.scala:115-129, for coordinates whose ``w``
+    exceeds one chip's HBM).
+
+    Returns ``fit(w0, batch) -> OptimizeResult`` with ``result.w`` sharded
+    P('feature'). ``dim`` must be pre-padded (see ``padded_dim``).
+    """
+    vg = sparse_value_and_grad_feature_sharded(objective, mesh, dim)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(
+            NamedSharding(mesh, P(FEATURE_AXIS)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+        ),
+    )
+    def fit(w0, label, indices, values, offset, weight) -> OptimizeResult:
+        batch = LabeledBatch(
+            label, SparseFeatures(indices, values, dim), offset, weight
+        )
+        return minimize_lbfgs(lambda w: vg(w, batch), w0, config, box=box)
+
+    def fit_batch(w0: Array, batch: LabeledBatch) -> OptimizeResult:
+        feats = batch.features
+        assert isinstance(feats, SparseFeatures)
+        return fit(
+            w0, batch.label, feats.indices, feats.values, batch.offset, batch.weight
+        )
+
+    return fit_batch

@@ -81,9 +81,10 @@ class DaysRange:
     def parse(spec: str) -> "DaysRange":
         try:
             start_s, end_s = spec.split(_DELIM)
-            return DaysRange(int(start_s), int(end_s))
+            start, end = int(start_s), int(end_s)
         except ValueError as e:
             raise ValueError(f"Couldn't parse the days range: {spec}") from e
+        return DaysRange(start, end)
 
     def to_date_range(self, today: Optional[_dt.date] = None) -> DateRange:
         today = today or _dt.date.today()
@@ -106,6 +107,7 @@ def resolve_range_paths(
     if date_range is None:
         return list(base_dirs)
     out: List[str] = []
+    missing: List[str] = []
     for base in base_dirs:
         daily = os.path.join(base, "daily")
         root = daily if os.path.isdir(daily) else base
@@ -113,9 +115,19 @@ def resolve_range_paths(
             p = os.path.join(root, f"{d.year:04d}", f"{d.month:02d}", f"{d.day:02d}")
             if os.path.isdir(p):
                 out.append(p)
+            else:
+                missing.append(p)
     if not out and errors_on_missing:
         raise FileNotFoundError(
             f"No input found in {list(base_dirs)} for date range {date_range}"
+        )
+    if missing:
+        # Days absent inside the range are skipped (reference
+        # IOUtils.getInputPathsWithinDateRange keeps only existing paths) but
+        # loudly: a silent gap means silently training on partial data.
+        logging.getLogger(__name__).warning(
+            "Date range %s: %d day dir(s) missing and skipped: %s",
+            date_range, len(missing), ", ".join(missing[:5]) + ("..." if len(missing) > 5 else ""),
         )
     return out
 
