@@ -5,17 +5,25 @@ descent pass of a GLMix logistic model — fixed effect (L-BFGS over the full
 batch, the reference's broadcast+treeAggregate loop compiled to one XLA
 program) + per-user random effects (vmapped per-entity L-BFGS solves).
 
-Metric: samples/sec/chip = LabeledPoint visits / wall time, where visits are
-counted EXACTLY on both sides (every objective evaluation including
-line-search trials × the samples it touches) — the unit the reference's
-aggregator hot loop is measured in (ValueAndGradientAggregator.add,
-SURVEY.md §3.1). The CPU baseline uses scipy's reported nfev identically.
+Metric: samples/sec/chip = LabeledPoint feature-pass visits / wall time.
+One visit = one sample's feature vector processed in ONE pass (a margin
+matvec contribution or a gradient scatter contribution) — the unit of the
+reference's aggregator hot loop (ValueAndGradientAggregator.add does the
+dot AND the axpy in one pass, so one reference eval = 2 passes worth of
+flops; counted as 2 visits here). Counted EXACTLY on both sides: the TPU
+margin-L-BFGS reports X passes directly (OptimizeResult.evals), scipy's
+nfev×2 counts its forward+transpose passes.
 
 vs_baseline: ratio against the same workload solved on CPU with
 scipy.optimize L-BFGS-B (BLAS-backed, single node) — the stand-in for the
 reference's Spark-CPU path (the reference publishes no numbers; BASELINE.md
 requires a measured CPU baseline). Baseline measured on this image's CPU:
 see BASELINE_SAMPLES_PER_SEC below.
+
+Timing notes: the axon TPU tunnel caches executions with identical
+arguments and its block_until_ready is not a reliable fence, so every timed
+repetition uses a DIFFERENT initial point and the clock stops only after a
+host transfer of a result scalar.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -29,8 +37,9 @@ import numpy as np
 
 # Measured via `python bench.py --measure-cpu-baseline` on the build image's
 # CPU (scipy L-BFGS-B, float32 BLAS): identical workload, identical
-# data-pass accounting. Re-measure when the workload changes.
-BASELINE_SAMPLES_PER_SEC = 2.88e6
+# feature-pass accounting (nfev × 2 passes). Re-measure when the workload
+# changes.
+BASELINE_SAMPLES_PER_SEC = 6.57e6
 
 # Workload size (per chip).
 N = 1 << 19  # 524288 samples
@@ -87,29 +96,37 @@ def run_tpu_bench():
         )
     )
 
-    args = (
-        jnp.zeros((D_FIX,), jnp.float32),
-        jnp.zeros((E, D_RE), jnp.float32),
-        LabeledBatch(jnp.asarray(y), jnp.asarray(Xf)),
-        block,
-        jnp.asarray(Xr),
-        jnp.asarray(users),
-    )
-    # Warm-up (compile)
-    out = step(*args)
-    jax.block_until_ready(out)
-    # Timed runs; visits counted exactly from the optimizer's eval counters.
-    times = []
-    for _ in range(3):
+    fe_batch = LabeledBatch(jnp.asarray(y), jnp.asarray(Xf))
+    Xr_j, users_j = jnp.asarray(Xr), jnp.asarray(users)
+
+    def args_for(rep: int):
+        # Distinct initial points per repetition — identical-argument
+        # executions are served from the tunnel's result cache.
+        return (
+            jnp.full((D_FIX,), 1e-4 * (rep + 1), jnp.float32),
+            jnp.full((E, D_RE), 1e-4 * (rep + 1), jnp.float32),
+            fe_batch,
+            block,
+            Xr_j,
+            users_j,
+        )
+
+    # Warm-up (compile) + result sync via host transfer.
+    out = step(*args_for(99))
+    float(out[2].sum())
+    times, visits = [], []
+    for rep in range(3):
         t0 = time.perf_counter()
-        out = step(*args)
-        jax.block_until_ready(out)
+        out = step(*args_for(rep))
+        _w, _coefs, scores, fe_evals, re_visits = out
+        # Host transfers force real completion (block_until_ready is not a
+        # reliable fence through the tunnel).
+        v = N * int(fe_evals) + int(re_visits)
+        float(scores.sum())
         times.append(time.perf_counter() - t0)
-    dt = min(times)
-    _w, _coefs, _scores, fe_evals, re_visits = out
-    visits = N * int(fe_evals) + int(re_visits)
-    sps = visits / dt
-    return sps, dt
+        visits.append(v)
+    i = int(np.argmin(times))
+    return visits[i] / times[i], times[i]
 
 
 def measure_cpu_baseline():
@@ -136,7 +153,7 @@ def measure_cpu_baseline():
         options=dict(maxiter=FE_ITERS),
     )
     t_fe = time.perf_counter() - t0
-    visits_fe = N * res.nfev
+    visits_fe = 2 * N * res.nfev  # each nfev = forward + transpose pass
 
     # Random-effect phase: solve a sample of entities, extrapolate.
     order = np.argsort(users, kind="stable")
@@ -162,7 +179,7 @@ def measure_cpu_baseline():
             fe_ge, np.zeros(D_RE), jac=True, method="L-BFGS-B",
             options=dict(maxiter=RE_ITERS),
         )
-        sample_visits += len(rows) * r.nfev
+        sample_visits += 2 * len(rows) * r.nfev
     t_re = (time.perf_counter() - t0) * scale
     visits_re = sample_visits * scale
 

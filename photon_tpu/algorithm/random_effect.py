@@ -37,7 +37,8 @@ from photon_tpu.optim.common import (
     REASON_GRADIENT_CONVERGED,
     REASON_MAX_ITERATIONS,
 )
-from photon_tpu.optim.lbfgs import minimize_lbfgs
+from photon_tpu.optim.lbfgs import minimize_lbfgs  # noqa: F401 (TRON/HVP paths)
+from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
 from photon_tpu.optim.tron import minimize_tron
 from photon_tpu.optim.owlqn import minimize_owlqn
 from photon_tpu.optim.factory import OptimizerSpec
@@ -99,8 +100,25 @@ def _solve_block(
             res = minimize_owlqn(vg, w_init, objective.l1_weight, config, l1_mask)
         elif spec.optimizer == OptimizerType.TRON:
             res = minimize_tron(vg, hvp, w_init, config, spec.max_cg_iter)
-        else:
+        elif feature_mask is not None and (
+            objective.normalization is not None
+            and objective.normalization.shifts is not None
+        ):
+            # Shift normalization computes es over the FULL w, so masking X
+            # columns does not silence masked coordinates (they'd train as
+            # pseudo-intercepts). Keep the gradient-masked formulation.
             res = minimize_lbfgs(vg, w_init, config)
+        else:
+            # Margin-space L-BFGS on the feature-masked batch: X∘m keeps the
+            # GLM margin structure, and masked coordinates (appearing only in
+            # the separable L2 term) reach the same post-mask optimum as the
+            # gradient-masked formulation.
+            lb_m = (
+                LabeledBatch(lab, feat * fmask[None, :], off, wt)
+                if feature_mask is not None
+                else lb
+            )
+            res = minimize_lbfgs_margin(objective, lb_m, w_init, config)
         w_out = res.w * fmask if feature_mask is not None else res.w
         # Entities under the lower-bound filter keep their initial model
         # (reference filterActiveData semantics: not trained this pass).

@@ -57,6 +57,11 @@ class GLMObjective:
         default=None, metadata=dict(static=True)
     )
     normalization: Optional[NormalizationContext] = None
+    # Route dense value_and_grad through the fused Pallas kernel (one HBM
+    # pass over X instead of XLA's two; photon_tpu.ops.pallas_glm). Falls
+    # back automatically where the kernel doesn't apply (sparse features,
+    # shift normalization, very wide dims).
+    use_pallas: bool = dataclasses.field(default=False, metadata=dict(static=True))
 
     # ----- margins -----
 
@@ -94,7 +99,35 @@ class GLMObjective:
     # ----- DiffFunction.calculate -----
 
     def value_and_grad(self, w: Array, batch: LabeledBatch) -> Tuple[Array, Array]:
+        if self._can_fuse(batch):
+            return self._pallas_value_and_grad(w, batch)
         return jax.value_and_grad(self.value)(w, batch)
+
+    def _can_fuse(self, batch: LabeledBatch) -> bool:
+        if not self.use_pallas:
+            return False
+        from photon_tpu.ops.pallas_glm import MAX_FUSED_DIM
+
+        feats = batch.features
+        if isinstance(feats, SparseFeatures) or feats.shape[1] > MAX_FUSED_DIM:
+            return False
+        norm = self.normalization
+        return norm is None or norm.shifts is None
+
+    def _pallas_value_and_grad(self, w: Array, batch: LabeledBatch) -> Tuple[Array, Array]:
+        from photon_tpu.ops.pallas_glm import fused_data_value_and_grad
+
+        f = None if self.normalization is None else self.normalization.factors
+        ew = w if f is None else w * f
+        val, g = fused_data_value_and_grad(
+            self.loss, ew, batch.features, batch.label, batch.offset, batch.weight
+        )
+        if f is not None:
+            g = g * f
+        if self.l2_weight != 0.0:
+            val = val + self.l2_term(w)
+            g = g + self.l2_weight * self._l2_mask(w)
+        return val.astype(w.dtype), g.astype(w.dtype)
 
     def grad(self, w: Array, batch: LabeledBatch) -> Array:
         return jax.grad(self.value)(w, batch)

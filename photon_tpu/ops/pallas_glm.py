@@ -1,0 +1,154 @@
+"""Pallas TPU kernel: fused GLM objective value + gradient in one pass over X.
+
+Role parity: the reference's aggregator hot loop — per-sample dot product +
+axpy accumulated across the cluster (ValueAndGradientAggregator.add/merge,
+photon-lib aggregators/ValueAndGradientAggregator.scala:242-285). On TPU the
+same computation as XLA emits it is TWO passes over X in HBM per objective
+evaluation: one for ``z = X @ w`` and one for ``grad = Xᵀ · dz`` (the
+transpose blocks fusion). Since the fixed-effect solve is HBM-bandwidth
+bound (SURVEY.md §6 cost model: one such evaluation per L-BFGS line-search
+point), halving X traffic halves the step time.
+
+This kernel streams row-tiles of X through VMEM once per evaluation:
+
+    per tile:  z  = X_tile @ w + offset          (MXU)
+               lv = weight · loss(z, y)          (VPU, fused)
+               dz = weight · loss'(z, y)         (VPU, fused)
+               loss_acc += Σ lv                  (SMEM scalar)
+               grad_acc += X_tileᵀ @ dz          (MXU, VMEM accumulator)
+
+Grid steps on TPU are sequential per core, so accumulating into the same
+output block across steps is race-free (standard reduction pattern). The
+feature dimension is kept whole per tile (w and one (TILE_N, d) tile must
+fit VMEM) — beyond that, the replicated path or the feature-sharded
+shard_map path (photon_tpu.parallel.feature_sharded) applies.
+
+L2/normalization are folded by the wrapper (effective-coefficient algebra,
+photon_tpu.data.normalization), keeping the kernel a pure data-loss pass.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from photon_tpu.ops.losses import PointwiseLoss
+
+Array = jax.Array
+
+# Row-tile height. 512 rows × 2048 features × 4B = 4 MB of VMEM for the X
+# tile — comfortably within the ~16 MB budget alongside w and accumulators.
+DEFAULT_TILE_N = 512
+# Feature dims above this exceed the VMEM tile budget; callers fall back.
+MAX_FUSED_DIM = 4096
+
+
+def _kernel(loss: PointwiseLoss, w_ref, x_ref, y_ref, off_ref, wt_ref,
+            loss_ref, grad_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+
+    x = x_ref[:]
+    # All values kept rank-2 (Mosaic-friendly layouts; scalar/1-D reductions
+    # with accumulation fail to lower — "Offset change").
+    z = jnp.dot(x, w_ref[:], preferred_element_type=jnp.float32) + off_ref[:]
+    y = y_ref[:]
+    wt = wt_ref[:]
+
+    lv = wt * loss.value(z, y)
+    dz = wt * loss.dz(z, y)
+
+    # Per-tile loss partial (summed by the wrapper; avoids cross-step scalar
+    # accumulation in SMEM, which Mosaic can't lower). The (tile_n,1)→(1,1)
+    # reduce rides the MXU as a dot with ones.
+    ones = jnp.ones((lv.shape[0], 1), jnp.float32)
+    tile_sum = jax.lax.dot_general(
+        lv, ones,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    loss_ref[pl.ds(i, 1), :] = tile_sum
+    # Xᵀ · dz, contracting over the row (sample) axis: (d, 1), accumulated
+    # across sequential grid steps.
+    grad_ref[:] += jax.lax.dot_general(
+        x, dz,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def fused_data_value_and_grad(
+    loss: PointwiseLoss,
+    w: Array,
+    X: Array,
+    label: Array,
+    offset: Array,
+    weight: Array,
+    tile_n: int = DEFAULT_TILE_N,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Σᵢ wᵢ·loss(xᵢ·w + offsetᵢ, yᵢ) and its gradient w.r.t. ``w``, in one
+    pass over ``X``. Pure data term — no regularization, no normalization.
+
+    Pads rows to the tile height with weight-0 samples and features to the
+    lane width; both paddings are exact (zero contribution).
+    ``interpret=None`` auto-selects interpreter mode off-TPU (CPU tests).
+
+    ``X`` may be bfloat16 (half the HBM traffic of the bandwidth-bound read);
+    margins and all accumulation stay float32 via preferred_element_type.
+    """
+    n, d = X.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    n_pad = int(np.ceil(max(n, 1) / tile_n) * tile_n)
+    d_pad = int(np.ceil(max(d, 1) / 128) * 128)
+    if n_pad != n or d_pad != d:
+        X = jnp.pad(X, ((0, n_pad - n), (0, d_pad - d)))
+        label = jnp.pad(label, (0, n_pad - n))
+        offset = jnp.pad(offset, (0, n_pad - n))
+        weight = jnp.pad(weight, (0, n_pad - n))  # 0-weight padding rows
+        w = jnp.pad(w, (0, d_pad - d))
+
+    # w must match X's dtype — Mosaic stalls lowering mixed-dtype dots. With
+    # bf16 X the margin matmul runs bf16×bf16 → f32 (preferred_element_type);
+    # value/grad accumulation is f32 either way.
+    w2 = w.astype(X.dtype)[:, None]
+    col = lambda v: v.astype(jnp.float32)[:, None]
+
+    n_tiles = n_pad // tile_n
+    loss_out, grad_out = pl.pallas_call(
+        functools.partial(_kernel, loss),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),           # w
+            pl.BlockSpec((tile_n, d_pad), lambda i: (i, 0)),      # X row tile
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),          # y
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),          # offset
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),          # weight
+        ],
+        out_specs=[
+            # Full-array resident block; each step stores its own row.
+            pl.BlockSpec((n_tiles, 1), lambda i: (0, 0)),
+            pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.float32),
+            jax.ShapeDtypeStruct((d_pad, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(w2, X, col(label), col(offset), col(weight))
+
+    value = jnp.sum(loss_out)
+    grad = grad_out[:, 0]
+    if d_pad != d:
+        grad = grad[:d]
+    return value, grad

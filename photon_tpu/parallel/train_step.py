@@ -24,7 +24,7 @@ from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.random_effect import EntityBlock
 from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.optim.common import OptimizerConfig
-from photon_tpu.optim.lbfgs import minimize_lbfgs
+from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
 from photon_tpu.parallel.mesh import DATA_AXIS
 
 Array = jax.Array
@@ -47,8 +47,9 @@ def glmix_train_step(
     entities on 'data', coefficients replicated.
 
     Also returns exact work counters for throughput accounting:
-    ``fe_evals`` (fixed-effect objective evaluations incl. line search) and
-    ``re_sample_visits`` (Σ_e evals_e × n_e over entities).
+    ``fe_evals`` (fixed-effect X passes — the margin solver's cost unit;
+    O(n) line-search trials are excluded) and ``re_sample_visits``
+    (Σ_e passes_e × n_e over entities).
 
     Smooth objectives only: L1/elastic-net training routes through the
     coordinate-descent path (OWL-QN); see photon_tpu.algorithm.
@@ -74,10 +75,10 @@ def glmix_train_step(
             return jnp.where(valid, jnp.sum(re_features_flat * w, axis=-1), 0.0)
 
         # --- fixed effect trains against RE residuals ---
-        fe_res = minimize_lbfgs(
-            lambda w: fixed_objective.value_and_grad(
-                w, fe_batch.add_scores_to_offsets(re_scores_of(re_coefs))
-            ),
+        # Margin-space L-BFGS: 2 X-passes/iter, O(n) line-search trials.
+        fe_res = minimize_lbfgs_margin(
+            fixed_objective,
+            fe_batch.add_scores_to_offsets(re_scores_of(re_coefs)),
             w_fixed,
             fe_config,
         )
@@ -89,9 +90,7 @@ def glmix_train_step(
 
         def solve_one(feat, lab, wt, off, w_init):
             lb = LabeledBatch(lab, feat, off, wt)
-            res = minimize_lbfgs(
-                lambda w: re_objective.value_and_grad(w, lb), w_init, re_config
-            )
+            res = minimize_lbfgs_margin(re_objective, lb, w_init, re_config)
             return res.w, res.evals
 
         w_init = re_coefs[re_block.entity_idx]

@@ -1,0 +1,125 @@
+"""Margin-space L-BFGS vs black-box L-BFGS equivalence tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+from photon_tpu.data.normalization import NormalizationContext
+from photon_tpu.ops.losses import LogisticLoss, PoissonLoss, SquaredLoss
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.common import OptimizerConfig
+from photon_tpu.optim.lbfgs import minimize_lbfgs
+from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
+
+
+def _problem(n, d, seed=0, poisson=False):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[:, 0] = 1.0
+    w = (rng.normal(size=d) / np.sqrt(d)).astype(np.float32)
+    z = X @ w
+    if poisson:
+        y = rng.poisson(np.exp(np.clip(z, None, 3))).astype(np.float32)
+    else:
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+    weight = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    offset = (rng.normal(size=n) * 0.2).astype(np.float32)
+    return X, y, weight, offset
+
+
+@pytest.mark.parametrize(
+    "loss,poisson", [(LogisticLoss, False), (PoissonLoss, True), (SquaredLoss, False)]
+)
+def test_margin_matches_blackbox(loss, poisson):
+    n, d = 256, 16
+    X, y, weight, offset = _problem(n, d, poisson=poisson)
+    batch = LabeledBatch(jnp.asarray(y), jnp.asarray(X), jnp.asarray(offset), jnp.asarray(weight))
+    obj = GLMObjective(loss=loss, l2_weight=1.0, intercept_index=0)
+    cfg = OptimizerConfig(max_iter=60, tol=1e-8, track_history=False)
+    res_m = jax.jit(lambda w: minimize_lbfgs_margin(obj, batch, w, cfg))(
+        jnp.zeros(d, jnp.float32)
+    )
+    res_b = jax.jit(
+        lambda w: minimize_lbfgs(lambda v: obj.value_and_grad(v, batch), w, cfg)
+    )(jnp.zeros(d, jnp.float32))
+    np.testing.assert_allclose(np.asarray(res_m.w), np.asarray(res_b.w), rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(float(res_m.value), float(res_b.value), rtol=1e-5)
+    # The whole point: far fewer X passes than black-box evals×2.
+    assert int(res_m.evals) <= 2 * (int(res_m.iterations) + 1)
+
+
+def test_margin_with_full_normalization():
+    """Factors+shifts normalization: same optimum as the black-box path."""
+    n, d = 200, 8
+    X, y, weight, offset = _problem(n, d, seed=4)
+    factors = np.linspace(0.5, 2.0, d).astype(np.float32)
+    shifts = np.linspace(-0.4, 0.6, d).astype(np.float32)
+    factors[0], shifts[0] = 1.0, 0.0  # intercept untouched
+    norm = NormalizationContext(
+        factors=jnp.asarray(factors), shifts=jnp.asarray(shifts), intercept_index=0
+    )
+    obj = GLMObjective(
+        loss=LogisticLoss, l2_weight=0.5, intercept_index=0, normalization=norm
+    )
+    batch = LabeledBatch(jnp.asarray(y), jnp.asarray(X), jnp.asarray(offset), jnp.asarray(weight))
+    cfg = OptimizerConfig(max_iter=60, tol=1e-8, track_history=False)
+    res_m = minimize_lbfgs_margin(obj, batch, jnp.zeros(d, jnp.float32), cfg)
+    res_b = minimize_lbfgs(
+        lambda v: obj.value_and_grad(v, batch), jnp.zeros(d, jnp.float32), cfg
+    )
+    np.testing.assert_allclose(np.asarray(res_m.w), np.asarray(res_b.w), rtol=5e-3, atol=5e-4)
+
+
+def test_margin_sparse_features():
+    n, d, k = 128, 40, 5
+    rng = np.random.default_rng(9)
+    indices = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    values = rng.normal(size=(n, k)).astype(np.float32)
+    Xd = np.zeros((n, d), np.float32)
+    for i in range(n):
+        for j in range(k):
+            Xd[i, indices[i, j]] += values[i, j]
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    sp = SparseFeatures(jnp.asarray(indices), jnp.asarray(values), d)
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+    cfg = OptimizerConfig(max_iter=40, tol=1e-8, track_history=False)
+    res_sp = minimize_lbfgs_margin(
+        obj, LabeledBatch(jnp.asarray(y), sp), jnp.zeros(d, jnp.float32), cfg
+    )
+    res_dn = minimize_lbfgs_margin(
+        obj, LabeledBatch(jnp.asarray(y), jnp.asarray(Xd)), jnp.zeros(d, jnp.float32), cfg
+    )
+    np.testing.assert_allclose(np.asarray(res_sp.w), np.asarray(res_dn.w), rtol=5e-3, atol=2e-3)
+
+
+def test_margin_rejects_l1():
+    obj = GLMObjective(loss=LogisticLoss, l1_weight=0.1)
+    batch = LabeledBatch(jnp.zeros(4), jnp.zeros((4, 2)))
+    with pytest.raises(ValueError, match="smooth"):
+        minimize_lbfgs_margin(obj, batch, jnp.zeros(2))
+
+
+def test_margin_vmappable():
+    """vmap over many small problems (the random-effect use case)."""
+    E, n, d = 8, 32, 4
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(E, n, d)).astype(np.float32)
+    y = (rng.uniform(size=(E, n)) < 0.5).astype(np.float32)
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+    cfg = OptimizerConfig(max_iter=20, track_history=False)
+
+    def solve(Xe, ye):
+        return minimize_lbfgs_margin(
+            obj, LabeledBatch(ye, Xe), jnp.zeros(d, jnp.float32), cfg
+        ).w
+
+    ws = jax.vmap(solve)(jnp.asarray(X), jnp.asarray(y))
+    assert ws.shape == (E, d)
+    for e in range(E):
+        w_ref = minimize_lbfgs_margin(
+            obj, LabeledBatch(jnp.asarray(y[e]), jnp.asarray(X[e])),
+            jnp.zeros(d, jnp.float32), cfg,
+        ).w
+        np.testing.assert_allclose(np.asarray(ws[e]), np.asarray(w_ref), rtol=1e-3, atol=1e-3)
