@@ -81,12 +81,21 @@ class CoordinateDescent:
         validation_batch: Optional[GameBatch] = None,
         validation_fn: Optional[Callable[[GameModel, GameBatch], Dict[str, float]]] = None,
         better: Callable[[float, float], bool] = lambda new, old: new < old,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
+        checkpoint_tag: Optional[str] = None,
     ) -> CoordinateDescentResult:
         """Descend; with validation data, tracks the best model seen across
         iterations by the primary metric (descendWithValidation role).
 
         ``better(new, old)`` encodes metric direction (reference
         EvaluatorType.op); default assumes lower-is-better.
+
+        With ``checkpoint_dir``, full descent state (models, score arrays,
+        iteration counter, metric history) is persisted every
+        ``checkpoint_every`` iterations and training RESUMES from the latest
+        checkpoint found there — mid-training recovery the reference lacks
+        (its warm start is model-only, SURVEY.md §5).
         """
         n = batch.n
         dtype = batch.offset.dtype
@@ -119,9 +128,38 @@ class CoordinateDescent:
             m is not None for m in models.values()
         ) else None
 
+        start_it = 0
+        if checkpoint_dir is not None:
+            if checkpoint_every < 1:
+                raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+            from photon_tpu.utils.checkpoint import latest_step, load_checkpoint
+
+            tag = checkpoint_tag or ",".join(self.update_sequence)
+            step = latest_step(checkpoint_dir)
+            if step is not None:
+                state, _ = load_checkpoint(checkpoint_dir, step)
+                if state.get("tag") != tag:
+                    raise ValueError(
+                        f"checkpoint at {checkpoint_dir} was written for a "
+                        f"different configuration (saved tag {state.get('tag')!r}"
+                        f" != current {tag!r}); clear the directory or point "
+                        "checkpoint_dir elsewhere"
+                    )
+                models = state["models"]
+                scores = state["scores"]
+                total_scores = state["total_scores"]
+                metric_history = state["metric_history"]
+                best_metric = state["best_metric"]
+                best_model = state["best_model"]
+                tracker = state["tracker"]
+                start_it = step + 1
+                logger.info(
+                    "resuming coordinate descent from checkpoint step %d", step
+                )
+
         single = len(self.update_sequence) == 1 and self.num_iterations == 1
 
-        for it in range(self.num_iterations):
+        for it in range(start_it, self.num_iterations):
             for cid in self.update_sequence:
                 if cid in self.locked:
                     continue
@@ -150,6 +188,24 @@ class CoordinateDescent:
                     best_metric = primary
                     best_model = game_model
                 logger.info("CD iter %d validation: %s", it, metrics)
+
+            if checkpoint_dir is not None and (it + 1) % checkpoint_every == 0:
+                from photon_tpu.utils.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    checkpoint_dir,
+                    dict(
+                        models=models,
+                        scores=scores,
+                        total_scores=total_scores,
+                        metric_history=metric_history,
+                        best_metric=best_metric,
+                        best_model=best_model,
+                        tracker=tracker,
+                        tag=checkpoint_tag or ",".join(self.update_sequence),
+                    ),
+                    it,
+                )
 
         final = GameModel(dict(models))
         if best_model is None:

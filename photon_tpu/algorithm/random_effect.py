@@ -47,10 +47,12 @@ from photon_tpu.types import OptimizerType, TaskType
 Array = jax.Array
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class RandomEffectTrackerStats:
     """Aggregate convergence stats across entity solves
-    (RandomEffectOptimizationTracker.scala role)."""
+    (RandomEffectOptimizationTracker.scala role). A pytree so trackers ride
+    along in coordinate-descent checkpoints."""
 
     num_entities: int
     num_converged: int

@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated coordinate ids to keep fixed (partial retrain)")
     p.add_argument("--output-mode", default="BEST", choices=["BEST", "ALL", "NONE"])
     p.add_argument("--variance-computation", action="store_true")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="mid-training checkpoint/resume directory (resumes "
+                        "automatically when state exists)")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="checkpoint cadence in CD iterations")
     return p
 
 
@@ -150,6 +155,8 @@ def run(args) -> Dict:
         validation_batch=valid_batch,
         evaluation_suite=suite if valid_batch is not None else None,
         initial_model=warm,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
     )
 
     os.makedirs(args.output_dir, exist_ok=True)

@@ -183,9 +183,16 @@ class GameEstimator:
         evaluation_suite: Optional[EvaluationSuite] = None,
         optimization_configs: Optional[Sequence[GameOptimizationConfig]] = None,
         initial_model: Optional[GameModel] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
     ) -> List[GameResult]:
         """Train one GameModel per optimization configuration, warm-starting
-        each config from the previous result (fit:364-382 role)."""
+        each config from the previous result (fit:364-382 role).
+
+        With ``checkpoint_dir``, each config's coordinate descent checkpoints
+        under ``<dir>/cfg_<i>`` and resumes from its latest state — an
+        already-finished config replays from its final checkpoint without
+        recomputation, so a preempted λ-sweep continues where it stopped."""
         with Timed("game-estimator/prepare-datasets"):
             self._prepare_datasets(batch)
 
@@ -201,7 +208,7 @@ class GameEstimator:
 
         results: List[GameResult] = []
         warm = initial_model
-        for opt_config in configs:
+        for cfg_idx, opt_config in enumerate(configs):
             with Timed(f"game-estimator/train[{opt_config.describe()}]"):
                 coords = self._build_coordinates(batch, opt_config)
                 cd = CoordinateDescent(
@@ -216,6 +223,16 @@ class GameEstimator:
                     validation_batch=validation_batch,
                     validation_fn=validation_fn,
                     better=better if better is not None else (lambda a, b: a < b),
+                    checkpoint_dir=(
+                        None
+                        if checkpoint_dir is None
+                        else f"{checkpoint_dir}/cfg_{cfg_idx}"
+                    ),
+                    checkpoint_every=checkpoint_every,
+                    # Fingerprint the λ-sweep point: resuming against a
+                    # changed grid/sequence fails loudly instead of serving a
+                    # stale model from the same cfg index.
+                    checkpoint_tag=f"{opt_config.describe()}|{','.join(self.update_sequence)}",
                 )
             metrics = cd_result.metric_history[-1] if cd_result.metric_history else None
             results.append(

@@ -109,8 +109,14 @@ def fused_data_value_and_grad(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    n_pad = int(np.ceil(max(n, 1) / tile_n) * tile_n)
     d_pad = int(np.ceil(max(d, 1) / 128) * 128)
+    # Keep the X tile within a fixed VMEM budget regardless of dtype/width
+    # (Pallas double-buffers grid inputs, so the effective footprint is ~2×).
+    sublane = 16 if X.dtype == jnp.bfloat16 else 8
+    budget = 4 * 1024 * 1024
+    tile_cap = budget // (d_pad * X.dtype.itemsize)
+    tile_n = max(sublane, min(tile_n, (tile_cap // sublane) * sublane))
+    n_pad = int(np.ceil(max(n, 1) / tile_n) * tile_n)
     if n_pad != n or d_pad != d:
         X = jnp.pad(X, ((0, n_pad - n), (0, d_pad - d)))
         label = jnp.pad(label, (0, n_pad - n))

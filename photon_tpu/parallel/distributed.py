@@ -23,7 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_tpu.data.batch import LabeledBatch, SparseFeatures
-from photon_tpu.parallel.mesh import DATA_AXIS
+from photon_tpu.parallel.mesh import dp_axes
 
 
 def _pad_rows(a: jax.Array, target: int, fill=0):
@@ -57,13 +57,14 @@ def pad_batch(batch: LabeledBatch, target_n: int) -> LabeledBatch:
 
 def shard_batch(batch: LabeledBatch, mesh: Mesh) -> LabeledBatch:
     """Pad to a data-axis-divisible size and place on the mesh, samples
-    sharded over DATA_AXIS, feature dim replicated."""
-    n_shards = mesh.shape[DATA_AXIS]
+    sharded over the data-parallel axes, feature dim replicated."""
+    dp = dp_axes(mesh)
+    n_shards = int(np.prod([mesh.shape[a] for a in dp]))
     target = int(np.ceil(batch.n / n_shards) * n_shards)
     batch = pad_batch(batch, target)
 
-    vec = NamedSharding(mesh, P(DATA_AXIS))
-    mat = NamedSharding(mesh, P(DATA_AXIS, None))
+    vec = NamedSharding(mesh, P(dp))
+    mat = NamedSharding(mesh, P(dp, None))
 
     def place(x, sh):
         return jax.device_put(x, sh)

@@ -46,7 +46,7 @@ from photon_tpu.data.batch import LabeledBatch, SparseFeatures
 from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.optim.common import OptimizeResult, OptimizerConfig
 from photon_tpu.optim.lbfgs import minimize_lbfgs
-from photon_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
+from photon_tpu.parallel.mesh import FEATURE_AXIS, dp_axes
 
 Array = jax.Array
 
@@ -82,6 +82,7 @@ def sparse_value_and_grad_feature_sharded(
     """
     _check_objective(objective)
     n_feat = mesh.shape[FEATURE_AXIS]
+    dp = dp_axes(mesh)
     assert dim % n_feat == 0, f"dim {dim} not divisible by feature axis {n_feat}"
     shard = dim // n_feat
     loss = objective.loss
@@ -114,7 +115,7 @@ def sparse_value_and_grad_feature_sharded(
         grad_loc = jnp.zeros((shard,), values.dtype).at[
             local_idx.reshape(-1)
         ].add(contrib.reshape(-1))
-        grad_loc = jax.lax.psum(grad_loc, DATA_AXIS)
+        grad_loc = jax.lax.psum(grad_loc, dp)
 
         # L2 on the local shard; the (global) intercept is exempt.
         if l2 != 0.0:
@@ -128,17 +129,17 @@ def sparse_value_and_grad_feature_sharded(
             l2_local = jnp.zeros((), values.dtype)
 
         value = jax.lax.pmean(
-            jax.lax.psum(loss_local, DATA_AXIS), FEATURE_AXIS
-        ) + jax.lax.pmean(jax.lax.psum(l2_local, FEATURE_AXIS), DATA_AXIS)
+            jax.lax.psum(loss_local, dp), FEATURE_AXIS
+        ) + jax.lax.pmean(jax.lax.psum(l2_local, FEATURE_AXIS), dp)
         return value, grad_loc
 
     in_specs = (
         P(FEATURE_AXIS),          # w
-        P(DATA_AXIS, None),       # indices
-        P(DATA_AXIS, None),       # values
-        P(DATA_AXIS),             # label
-        P(DATA_AXIS),             # offset
-        P(DATA_AXIS),             # weight
+        P(dp, None),              # indices
+        P(dp, None),              # values
+        P(dp),                    # label
+        P(dp),                    # offset
+        P(dp),                    # weight
     )
     factor_spec = (P(FEATURE_AXIS),) if factors is not None else ()
     shmapped = jax.shard_map(
@@ -165,9 +166,10 @@ def place_feature_sharded(
     mesh: Mesh, w: Array, batch: LabeledBatch
 ) -> Tuple[Array, LabeledBatch]:
     """device_put ``w`` P('feature') and the sparse batch rows P('data')."""
+    dp = dp_axes(mesh)
     wsh = NamedSharding(mesh, P(FEATURE_AXIS))
-    rows = NamedSharding(mesh, P(DATA_AXIS))
-    rows2d = NamedSharding(mesh, P(DATA_AXIS, None))
+    rows = NamedSharding(mesh, P(dp))
+    rows2d = NamedSharding(mesh, P(dp, None))
     feats = batch.features
     assert isinstance(feats, SparseFeatures)
     put = jax.device_put
@@ -203,11 +205,11 @@ def train_fixed_effect_feature_sharded(
         jax.jit,
         in_shardings=(
             NamedSharding(mesh, P(FEATURE_AXIS)),
-            NamedSharding(mesh, P(DATA_AXIS)),
-            NamedSharding(mesh, P(DATA_AXIS, None)),
-            NamedSharding(mesh, P(DATA_AXIS, None)),
-            NamedSharding(mesh, P(DATA_AXIS)),
-            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P(dp_axes(mesh))),
+            NamedSharding(mesh, P(dp_axes(mesh), None)),
+            NamedSharding(mesh, P(dp_axes(mesh), None)),
+            NamedSharding(mesh, P(dp_axes(mesh))),
+            NamedSharding(mesh, P(dp_axes(mesh))),
         ),
     )
     def fit(w0, label, indices, values, offset, weight) -> OptimizeResult:

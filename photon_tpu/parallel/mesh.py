@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 ENTITY_AXIS = "data"  # entities shard over the same physical axis as samples
 FEATURE_AXIS = "feature"
+SLICE_AXIS = "slice"  # multi-slice (DCN) outer data axis
 
 
 def make_mesh(
@@ -51,13 +52,48 @@ def make_mesh(
     return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
 
 
+def make_multislice_mesh(
+    n_slices: Optional[int] = None,
+    n_feature: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(slice, data, feature) mesh for multi-slice pods.
+
+    The outer ``slice`` axis maps to DCN, the inner ``data`` axis to ICI —
+    gradient psums become hierarchical reductions (reduce inside each slice
+    over ICI, then once across slices over DCN), the TPU equivalent of the
+    reference's ``treeAggregate(depth=2)`` (SURVEY.md §2.8). Slice membership
+    comes from ``device.slice_index`` when the runtime exposes it; pass
+    ``n_slices`` to split explicitly (e.g. CPU tests).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_slices is None:
+        idx = {getattr(d, "slice_index", 0) for d in devs}
+        n_slices = max(len(idx), 1)
+    assert len(devs) % n_slices == 0, (n_slices, len(devs))
+    per_slice = len(devs) // n_slices
+    assert per_slice % n_feature == 0, (per_slice, n_feature)
+    # Slice-major ordering so each mesh row is one physical slice.
+    devs = sorted(devs, key=lambda d: (getattr(d, "slice_index", 0), d.id))
+    grid = np.asarray(devs).reshape(n_slices, per_slice // n_feature, n_feature)
+    return Mesh(grid, (SLICE_AXIS, DATA_AXIS, FEATURE_AXIS))
+
+
+def dp_axes(mesh: Mesh) -> tuple:
+    """The data-parallel mesh axes: ('slice', 'data') on a multi-slice mesh,
+    ('data',) otherwise. Use as a PartitionSpec entry or a psum axis set."""
+    if SLICE_AXIS in mesh.axis_names:
+        return (SLICE_AXIS, DATA_AXIS)
+    return (DATA_AXIS,)
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
-    """Per-sample arrays: sharded on the data axis."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Per-sample arrays: sharded on the data-parallel axes."""
+    return NamedSharding(mesh, P(dp_axes(mesh)))
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """(n, k) per-sample matrices (features/indices): row-sharded."""
-    return NamedSharding(mesh, P(DATA_AXIS, None))
+    return NamedSharding(mesh, P(dp_axes(mesh), None))
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

@@ -25,7 +25,7 @@ from photon_tpu.data.random_effect import EntityBlock
 from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.optim.common import OptimizerConfig
 from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
-from photon_tpu.parallel.mesh import DATA_AXIS
+from photon_tpu.parallel.mesh import dp_axes
 
 Array = jax.Array
 
@@ -127,10 +127,11 @@ def glmix_sharded_train_step(
     """
     step = glmix_train_step(fixed_objective, re_objective, fe_config, re_config)
 
+    dp = dp_axes(mesh)  # ('slice','data') on multi-slice meshes
     repl = NamedSharding(mesh, P())
-    rows = NamedSharding(mesh, P(DATA_AXIS))
-    rows2d = NamedSharding(mesh, P(DATA_AXIS, None))
-    rows3d = NamedSharding(mesh, P(DATA_AXIS, None, None))
+    rows = NamedSharding(mesh, P(dp))
+    rows2d = NamedSharding(mesh, P(dp, None))
+    rows3d = NamedSharding(mesh, P(dp, None, None))
 
     def place(w_fixed, re_coefs, fe_batch, re_block, re_features_flat, re_entity_ids):
         put = jax.device_put

@@ -1,0 +1,107 @@
+"""Mid-training checkpoint/resume for coordinate descent.
+
+The reference has NO mid-training checkpoint: its resume story is model-level
+warm start only (load previous GAME model as the initial point,
+GameTrainingDriver.scala:377-386, SURVEY.md §5 checkpoint/resume). This
+module is the SURVEY §7.8 improvement: the full coordinate-descent state —
+per-coordinate models, per-coordinate score arrays, residual total, iteration
+counter, metric history — persists to host storage, so a preempted job
+resumes mid-descent instead of restarting the λ-sweep entry.
+
+Format: one ``step_<N>.npz`` with the flattened pytree leaves plus a pickled
+treedef (all photon_tpu model classes are registered pytree nodes, so the
+treedef round-trips typed objects — GameModel/FixedEffectModel/... come back
+as themselves, not dict skeletons). bfloat16 leaves are stored as uint16
+views (npz has no bf16). A ``LATEST`` file names the newest step;
+``step_<N>`` files are self-contained so older steps remain loadable.
+
+Single-host persistence (np.savez gathers sharded arrays). Multi-host
+sharded checkpointing can swap in orbax behind the same API later.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LATEST = "LATEST"
+
+
+def _to_saveable(leaf):
+    arr = np.asarray(leaf)
+    if arr.dtype == jnp.bfloat16:
+        return arr.view(np.uint16), "bfloat16"
+    return arr, str(arr.dtype)
+
+
+def save_checkpoint(directory: str, state: Any, step: int) -> str:
+    """Persist a pytree ``state`` as step ``step``. Returns the file path."""
+    os.makedirs(directory, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    arrays = {}
+    dtypes = []
+    for i, leaf in enumerate(leaves):
+        arr, dt = _to_saveable(leaf)
+        arrays[f"leaf_{i}"] = arr
+        dtypes.append(dt)
+    payload = dict(
+        treedef=pickle.dumps(treedef),
+        dtypes=dtypes,
+        num_leaves=len(leaves),
+    )
+    path = os.path.join(directory, f"step_{step}.npz")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __meta__=np.frombuffer(pickle.dumps(payload), np.uint8), **arrays)
+    os.replace(tmp, path)  # atomic publish — no torn checkpoints on preemption
+    latest_tmp = os.path.join(directory, _LATEST + ".tmp")
+    with open(latest_tmp, "w") as f:
+        f.write(str(step))
+    os.replace(latest_tmp, os.path.join(directory, _LATEST))
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    p = os.path.join(directory, _LATEST)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        step = int(f.read().strip())
+    if not os.path.exists(os.path.join(directory, f"step_{step}.npz")):
+        return None
+    return step
+
+
+def load_checkpoint(directory: str, step: Optional[int] = None) -> Tuple[Any, int]:
+    """Load a checkpoint (latest by default) back into typed pytree objects."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+    with np.load(os.path.join(directory, f"step_{step}.npz"), allow_pickle=True) as z:
+        payload = pickle.loads(z["__meta__"].tobytes())
+        treedef = pickle.loads(payload["treedef"])
+        leaves = []
+        for i, dt in enumerate(payload["dtypes"]):
+            arr = z[f"leaf_{i}"]
+            if dt == "bfloat16":
+                arr = jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
+            elif arr.ndim == 0 and arr.dtype == object:
+                arr = arr.item()
+            elif arr.ndim == 0 and arr.dtype.kind in ("U", "S", "b"):
+                arr = arr.item()  # strings / bools round-trip as themselves
+            elif arr.ndim == 0 and arr.dtype in (np.float64, np.int64):
+                # Host python scalars (metric values, counters) round-trip as
+                # scalars — jnp would silently downcast float64 with x64 off.
+                arr = arr.item()
+            else:
+                # Device arrays on save → device arrays on restore (solvers
+                # rely on jnp semantics like .at[]).
+                arr = jnp.asarray(arr)
+            leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves), step

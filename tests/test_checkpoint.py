@@ -1,0 +1,181 @@
+"""Checkpoint/resume tests: pytree round-trip + mid-descent recovery."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from photon_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_tpu.algorithm.fixed_effect import FixedEffectCoordinate
+from photon_tpu.algorithm.random_effect import RandomEffectCoordinate
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.random_effect import (
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+)
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.ops.losses import LogisticLoss
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.factory import OptimizerSpec
+from photon_tpu.types import TaskType
+from photon_tpu.utils.checkpoint import (
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def test_pytree_roundtrip(tmp_path):
+    glm = GeneralizedLinearModel(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coefficients=Coefficients(means=jnp.arange(4.0)),
+    )
+    state = dict(
+        model=GameModel(
+            {
+                "fixed": FixedEffectModel(model=glm, feature_shard="g"),
+                "re": RandomEffectModel(
+                    coefficients=jnp.ones((3, 2)),
+                    re_type="u",
+                    feature_shard="r",
+                    task=TaskType.LOGISTIC_REGRESSION,
+                ),
+            }
+        ),
+        scores={"fixed": jnp.arange(5.0)},
+        history=[{"AUC": 0.9}, {"AUC": 0.95}],
+        none_field=None,
+        bf=jnp.arange(6, dtype=jnp.bfloat16),
+    )
+    save_checkpoint(str(tmp_path), state, 3)
+    assert latest_step(str(tmp_path)) == 3
+    restored, step = load_checkpoint(str(tmp_path))
+    assert step == 3
+    assert isinstance(restored["model"].models["fixed"], FixedEffectModel)
+    assert restored["model"].models["re"].re_type == "u"
+    np.testing.assert_array_equal(
+        np.asarray(restored["model"].models["fixed"].model.coefficients.means),
+        np.arange(4.0),
+    )
+    assert restored["none_field"] is None
+    assert [float(h["AUC"]) for h in restored["history"]] == [0.9, 0.95]
+    assert restored["bf"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(restored["bf"], np.float32), np.arange(6.0))
+
+
+def test_latest_step_empty(tmp_path):
+    assert latest_step(str(tmp_path)) is None
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path))
+
+
+def _glmix_setup(seed=0):
+    rng = np.random.default_rng(seed)
+    n, d_fix, d_re, E = 512, 8, 4, 16
+    Xf = rng.normal(size=(n, d_fix)).astype(np.float32)
+    Xf[:, 0] = 1.0
+    Xr = rng.normal(size=(n, d_re)).astype(np.float32)
+    Xr[:, 0] = 1.0
+    users = (np.arange(n) % E).astype(np.int32)
+    logits = Xf @ (rng.normal(size=d_fix).astype(np.float32) / np.sqrt(d_fix))
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    batch = GameBatch(
+        label=jnp.asarray(y),
+        offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+        features={"global": jnp.asarray(Xf), "per_user": jnp.asarray(Xr)},
+        entity_ids={"userId": jnp.asarray(users)},
+    )
+    fixed = FixedEffectCoordinate(
+        "global", "global", TaskType.LOGISTIC_REGRESSION,
+        GLMObjective(loss=LogisticLoss, l2_weight=1.0, intercept_index=0),
+        OptimizerSpec(),
+    )
+    ds = build_random_effect_dataset(
+        users, Xr, y, np.ones(n, np.float32), E,
+        RandomEffectDataConfig(re_type="userId", feature_shard="per_user"),
+    )
+    rand = RandomEffectCoordinate(
+        "per_user", ds, TaskType.LOGISTIC_REGRESSION,
+        GLMObjective(loss=LogisticLoss, l2_weight=0.5, intercept_index=0),
+    )
+    return batch, {"global": fixed, "per_user": rand}
+
+
+def test_cd_resume_matches_uninterrupted(tmp_path):
+    """3-iteration descent == 2 iterations + crash + resume for the last."""
+    batch, coords = _glmix_setup()
+    seq = ["global", "per_user"]
+
+    full = CoordinateDescent(dict(coords), seq, num_iterations=3).run(batch)
+
+    ck = str(tmp_path / "ck")
+    # "Crash" after 2 iterations (simulated by num_iterations=2).
+    CoordinateDescent(dict(coords), seq, num_iterations=2).run(
+        batch, checkpoint_dir=ck
+    )
+    assert latest_step(ck) == 1
+    # Resume run asks for 3 total; should do only iteration 2.
+    resumed = CoordinateDescent(dict(coords), seq, num_iterations=3).run(
+        batch, checkpoint_dir=ck
+    )
+    w_full = np.asarray(full.model.models["global"].model.coefficients.means)
+    w_res = np.asarray(resumed.model.models["global"].model.coefficients.means)
+    np.testing.assert_allclose(w_res, w_full, rtol=1e-5, atol=1e-6)
+    re_full = np.asarray(full.model.models["per_user"].coefficients)
+    re_res = np.asarray(resumed.model.models["per_user"].coefficients)
+    np.testing.assert_allclose(re_res, re_full, rtol=1e-5, atol=1e-6)
+
+
+def test_cd_checkpoint_tag_mismatch_raises(tmp_path):
+    batch, coords = _glmix_setup()
+    seq = ["global", "per_user"]
+    ck = str(tmp_path / "ck")
+    CoordinateDescent(dict(coords), seq, num_iterations=1).run(
+        batch, checkpoint_dir=ck, checkpoint_tag="lambda=1.0"
+    )
+    with pytest.raises(ValueError, match="different configuration"):
+        CoordinateDescent(dict(coords), seq, num_iterations=1).run(
+            batch, checkpoint_dir=ck, checkpoint_tag="lambda=2.0"
+        )
+
+
+def test_cd_checkpoint_every_validated(tmp_path):
+    batch, coords = _glmix_setup()
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        CoordinateDescent(dict(coords), ["global", "per_user"], num_iterations=1).run(
+            batch, checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=0
+        )
+
+
+def test_cd_resume_preserves_tracker(tmp_path):
+    batch, coords = _glmix_setup()
+    seq = ["global", "per_user"]
+    ck = str(tmp_path / "ck")
+    CoordinateDescent(dict(coords), seq, num_iterations=2).run(batch, checkpoint_dir=ck)
+    resumed = CoordinateDescent(dict(coords), seq, num_iterations=3).run(
+        batch, checkpoint_dir=ck
+    )
+    # Tracker covers ALL iterations including the pre-resume ones.
+    assert len(resumed.tracker["global"]) == 3
+    assert len(resumed.tracker["per_user"]) == 3
+    stats = resumed.tracker["per_user"][0]
+    assert int(stats.num_entities) == 16
+
+
+def test_cd_completed_run_replays_from_checkpoint(tmp_path):
+    batch, coords = _glmix_setup()
+    seq = ["global", "per_user"]
+    ck = str(tmp_path / "ck")
+    first = CoordinateDescent(dict(coords), seq, num_iterations=2).run(
+        batch, checkpoint_dir=ck
+    )
+    again = CoordinateDescent(dict(coords), seq, num_iterations=2).run(
+        batch, checkpoint_dir=ck
+    )
+    np.testing.assert_array_equal(
+        np.asarray(first.model.models["global"].model.coefficients.means),
+        np.asarray(again.model.models["global"].model.coefficients.means),
+    )
