@@ -56,6 +56,7 @@ def minimize_lbfgs_margin(
     batch: LabeledBatch,
     w0: Array,
     config: OptimizerConfig = OptimizerConfig(),
+    l2_override: Optional[Array] = None,
 ) -> OptimizeResult:
     """L-BFGS over a GLMObjective exploiting margin affinity.
 
@@ -63,12 +64,17 @@ def minimize_lbfgs_margin(
     on smooth GLMs, at ~2 X-passes per iteration. ``result.evals`` counts
     X passes (the full-data cost unit); O(n) margin-only line-search trials
     are not counted.
+
+    ``l2_override`` replaces the objective's static L2 weight with a TRACED
+    scalar — the hook that lets ``sweep_l2_lbfgs_margin`` vmap one program
+    over a whole λ grid.
     """
     if objective.l1_weight > 0.0:
         raise ValueError("margin L-BFGS is for smooth objectives; use OWL-QN for L1")
 
     loss = objective.loss
-    l2 = objective.l2_weight
+    l2 = objective.l2_weight if l2_override is None else l2_override
+    has_l2 = l2_override is not None or objective.l2_weight != 0.0
     norm = objective.normalization
     factors = None if norm is None or norm.is_identity else norm.factors
     shifts = None if norm is None or norm.is_identity else norm.shifts
@@ -90,7 +96,7 @@ def minimize_lbfgs_margin(
             g = g - jnp.sum(dz) * shifts
         if factors is not None:
             g = g * factors
-        if l2 != 0.0:
+        if has_l2:
             g = g + l2 * _l2_mask(w)
         return g
 
@@ -103,7 +109,7 @@ def minimize_lbfgs_margin(
         return jnp.sum(weight * loss.value(z, label))
 
     def l2_value(w: Array) -> Array:
-        if l2 == 0.0:
+        if not has_l2:
             return jnp.zeros((), w0.dtype)
         wm = _l2_mask(w)
         return 0.5 * l2 * jnp.dot(wm, wm)
@@ -150,7 +156,7 @@ def minimize_lbfgs_margin(
 
         u = matvec(p)  # the ONE X pass for this whole line search
         # L2 along the path: quadratic with analytic coefficients.
-        if l2 != 0.0:
+        if has_l2:
             wm, pm = _l2_mask(w), _l2_mask(p)
             l2_a = l2 * jnp.dot(wm, pm)
             l2_b = l2 * jnp.dot(pm, pm)
