@@ -3,30 +3,15 @@
 Mirrors the reference's "Spark-without-a-cluster" strategy
 (SparkTestUtils.sparkTest, local[*]) — distributed code paths are exercised
 against 8 fake CPU devices via XLA_FLAGS, no TPU needed for correctness
-(SURVEY.md §4 implication).
-
-IMPORTANT: this environment registers an 'axon' TPU-tunnel PJRT plugin at
-interpreter startup and exports JAX_PLATFORMS=axon. Tests must never touch
-that backend (a single wedged tunnel hangs every jax.devices() call), so we
-force the platform to cpu via jax.config (env vars are too late — the plugin
-hook reads them at sitecustomize time) and drop the axon factory before any
-backend is initialized.
+(SURVEY.md §4 implication). The backend-forcing dance (axon-plugin drop
+included) lives in photon_tpu.utils.virtual_devices, shared with the
+driver's dryrun entry point.
 """
 
-import os
+from photon_tpu.utils.virtual_devices import force_virtual_cpu_devices
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+force_virtual_cpu_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax._src import xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover - private API guard
-    pass
 
 jax.config.update("jax_enable_x64", False)
