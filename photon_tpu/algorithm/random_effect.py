@@ -29,7 +29,11 @@ from photon_tpu.algorithm.coordinate import Coordinate
 from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.game_data import GameBatch
 from photon_tpu.data.random_effect import EntityBlock, RandomEffectDataset, pearson_feature_mask
-from photon_tpu.models.game import RandomEffectModel
+from photon_tpu.models.game import (
+    DatumScoringModel,
+    ProjectedRandomEffectModel,
+    RandomEffectModel,
+)
 from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.optim.common import (
     OptimizerConfig,
@@ -164,31 +168,70 @@ class RandomEffectCoordinate(Coordinate):
                 k_e = jnp.clip(
                     jnp.ceil(counts.astype(jnp.float32) * ratio).astype(jnp.int32),
                     1,
-                    self.dataset.dim,
+                    block.dim,
                 )
                 self._feature_masks[i] = pearson_feature_mask(
-                    block, k_e, always_keep=self.objective.intercept_index
+                    block, k_e, always_keep=self._block_intercept(block)
                 )
+
+    def _block_intercept(self, block: EntityBlock) -> Optional[int]:
+        """Intercept column in BLOCK-local space (global index mapped through
+        the block's col_map under subspace projection)."""
+        g = self.objective.intercept_index
+        if g is None or block.col_map is None:
+            return g
+        import numpy as np
+
+        pos = np.flatnonzero(np.asarray(block.col_map) == g)
+        return int(pos[0]) if pos.size else None
+
+    def _block_objective(self, block: EntityBlock) -> GLMObjective:
+        """Objective with the intercept index (and any normalization
+        vectors) remapped to block space — the regularization exemption and
+        the folded normalization algebra must follow the projected columns."""
+        local = self._block_intercept(block)
+        norm = self.objective.normalization
+        if block.col_map is not None and norm is not None and not norm.is_identity:
+            norm = dataclasses.replace(
+                norm,
+                factors=None if norm.factors is None else norm.factors[block.col_map],
+                shifts=None if norm.shifts is None else norm.shifts[block.col_map],
+                intercept_index=local,
+            )
+            return dataclasses.replace(
+                self.objective, intercept_index=local, normalization=norm
+            )
+        if local == self.objective.intercept_index:
+            return self.objective
+        return dataclasses.replace(self.objective, intercept_index=local)
 
     def train(
         self,
         batch: GameBatch,
         residual_scores: Optional[Array] = None,
-        initial_model: Optional[RandomEffectModel] = None,
-    ) -> Tuple[RandomEffectModel, RandomEffectTrackerStats]:
-        E, d = self.dataset.num_entities, self.dataset.dim
-        dtype = batch.offset.dtype
-        coefs = (
-            initial_model.coefficients
-            if initial_model is not None
-            else jnp.zeros((E, d), dtype)
-        )
+        initial_model=None,  # RandomEffectModel | ProjectedRandomEffectModel
+    ) -> Tuple[DatumScoringModel, RandomEffectTrackerStats]:
         # Residuals for THIS coordinate's solves: batch offsets + other
         # coordinates' scores (addScoresToOffsets, gathered per block).
         total_offset = batch.offset
         if residual_scores is not None:
             total_offset = total_offset + residual_scores
+        if self.dataset.projected:
+            return self._train_projected(total_offset, initial_model)
+        return self._train_dense(batch, total_offset, initial_model)
 
+    def _train_dense(
+        self, batch: GameBatch, total_offset: Array, initial_model
+    ) -> Tuple[RandomEffectModel, RandomEffectTrackerStats]:
+        E, d = self.dataset.num_entities, self.dataset.dim
+        dtype = batch.offset.dtype
+        if isinstance(initial_model, ProjectedRandomEffectModel):
+            initial_model = initial_model.to_dense()
+        coefs = (
+            initial_model.coefficients
+            if initial_model is not None
+            else jnp.zeros((E, d), dtype)
+        )
         iter_list, reason_list = [], []
         for i, block in enumerate(self.dataset.blocks):
             offs = block.gather_offsets(total_offset)
@@ -211,6 +254,65 @@ class RandomEffectCoordinate(Coordinate):
         )
         stats = self._tracker_stats(iter_list, reason_list)
         return model, stats
+
+    def _train_projected(
+        self, total_offset: Array, initial_model
+    ) -> Tuple[ProjectedRandomEffectModel, RandomEffectTrackerStats]:
+        """Per-block solves in the compact subspace: nothing of width
+        ``d_full`` is ever materialized (model projection lives in the
+        block's col_map)."""
+        entity_block, entity_row, inv_maps = self.dataset.projection_tables()
+        iter_list, reason_list = [], []
+        block_coefs, block_vars, col_maps = [], [], []
+        for i, block in enumerate(self.dataset.blocks):
+            offs = block.gather_offsets(total_offset)
+            w0 = self._initial_block_coefs(block, i, initial_model)
+            obj = self._block_objective(block)
+            w_new, iters, reasons = _solve_block(
+                block, offs, w0, obj, self.optimizer_spec, self._config,
+                self._feature_masks.get(i),
+            )
+            block_coefs.append(w_new)
+            col_maps.append(block.col_map)
+            iter_list.append(iters)
+            reason_list.append(reasons)
+            if self.compute_variance:
+                def var_one(feat, lab, wt, off, w, _obj=obj):
+                    lb = LabeledBatch(lab, feat, off, wt)
+                    diag = _obj.hessian_diagonal(w, lb)
+                    return 1.0 / jnp.maximum(diag, 1e-12)
+
+                block_vars.append(
+                    jax.vmap(var_one)(
+                        block.features, block.label, block.weight, offs, w_new
+                    )
+                )
+        model = ProjectedRandomEffectModel(
+            block_coefs=block_coefs,
+            col_maps=col_maps,
+            inv_maps=inv_maps,
+            entity_block=entity_block,
+            entity_row=entity_row,
+            d_full=self.dataset.dim,
+            re_type=self.dataset.config.re_type,
+            feature_shard=self.dataset.config.feature_shard,
+            task=self.task,
+            block_variances=block_vars if self.compute_variance else None,
+        )
+        return model, self._tracker_stats(iter_list, reason_list)
+
+    def _initial_block_coefs(self, block, block_index: int, initial_model) -> Array:
+        """Warm-start coefficients in block space from either model form."""
+        E_b, d_b = block.num_entities, block.dim
+        if initial_model is None:
+            return jnp.zeros((E_b, d_b), jnp.float32)
+        if isinstance(initial_model, ProjectedRandomEffectModel):
+            prev = initial_model.block_coefs[block_index]
+            if prev.shape == (E_b, d_b):  # same dataset → same blocks
+                return prev
+            initial_model = initial_model.to_dense()
+        # Dense (E, d_full) model: gather rows, project into block space.
+        return block.project_forward(initial_model.coefficients[block.entity_idx])
 
     def _block_variances(self, coefs: Array, total_offset: Array, dtype) -> Array:
         """Per-entity coefficient variances via inverse diagonal Hessian
@@ -249,10 +351,26 @@ class RandomEffectCoordinate(Coordinate):
             max_iterations=int(jnp.max(iters)),
         )
 
-    def score(self, model: RandomEffectModel, batch: GameBatch) -> Array:
+    def score(self, model, batch: GameBatch) -> Array:
         return model.score(batch)
 
-    def zero_model(self) -> RandomEffectModel:
+    def zero_model(self):
+        if self.dataset.projected:
+            entity_block, entity_row, inv_maps = self.dataset.projection_tables()
+            return ProjectedRandomEffectModel(
+                block_coefs=[
+                    jnp.zeros((b.num_entities, b.dim), jnp.float32)
+                    for b in self.dataset.blocks
+                ],
+                col_maps=[b.col_map for b in self.dataset.blocks],
+                inv_maps=inv_maps,
+                entity_block=entity_block,
+                entity_row=entity_row,
+                d_full=self.dataset.dim,
+                re_type=self.dataset.config.re_type,
+                feature_shard=self.dataset.config.feature_shard,
+                task=self.task,
+            )
         return RandomEffectModel(
             jnp.zeros((self.dataset.num_entities, self.dataset.dim), jnp.float32),
             self.dataset.config.re_type,

@@ -61,7 +61,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-input-dir", default=None, help="warm-start model dir")
     p.add_argument("--locked-coordinates", default="",
                    help="comma-separated coordinate ids to keep fixed (partial retrain)")
-    p.add_argument("--output-mode", default="BEST", choices=["BEST", "ALL", "NONE"])
+    p.add_argument(
+        "--output-mode",
+        default="BEST",
+        choices=["BEST", "ALL", "NONE", "EXPLICIT", "TUNED"],
+        help="reference ModelOutputMode: BEST = best model overall, ALL = "
+             "every trained model, EXPLICIT = best of the explicit λ grid, "
+             "TUNED = best hyperparameter-tuned model, NONE = no model output",
+    )
+    # Hyperparameter auto-tuning (reference GameTrainingDriver.scala:651-692).
+    p.add_argument(
+        "--hyper-parameter-tuning",
+        default="NONE",
+        choices=["NONE", "RANDOM", "BAYESIAN"],
+        help="tune regularization hyperparameters after the explicit grid "
+             "(RANDOM = Sobol search, BAYESIAN = GP + expected improvement)",
+    )
+    p.add_argument("--hyper-parameter-tuning-iter", type=int, default=10)
+    p.add_argument(
+        "--hyper-parameter-tuner",
+        default="ATLAS",
+        choices=["DUMMY", "ATLAS"],
+        help="tuner implementation (reference HyperparameterTunerFactory)",
+    )
     p.add_argument("--variance-computation", action="store_true")
     p.add_argument("--checkpoint-dir", default=None,
                    help="mid-training checkpoint/resume directory (resumes "
@@ -159,22 +181,48 @@ def run(args) -> Dict:
         checkpoint_every=args.checkpoint_every,
     )
 
+    # --- hyperparameter auto-tuning (runHyperparameterTuning role,
+    # reference GameTrainingDriver.scala:651-692) ---
+    tuned_results = []
+    if args.hyper_parameter_tuning != "NONE":
+        tuned_results = _run_hyperparameter_tuning(
+            args, estimator, results, batch, valid_batch, suite
+        )
+
     os.makedirs(args.output_dir, exist_ok=True)
-    summary = {"configs": [], "best": None}
-    best = (
-        estimator.select_best(results, suite)
-        if suite is not None and valid_batch is not None
-        else results[-1]
-    )
-    for i, r in enumerate(results):
-        entry = {"config": r.config.describe(), "metrics": r.metrics}
-        summary["configs"].append(entry)
-        if args.output_mode == "ALL":
-            save_game_model(
-                r.model, os.path.join(args.output_dir, f"models", str(i)),
-                index_maps, entity_indexes,
+    summary = {"configs": [], "tuned_configs": [], "best": None}
+
+    def _select(candidates):
+        if not candidates:
+            return None
+        if suite is not None and valid_batch is not None:
+            return estimator.select_best(candidates, suite)
+        return candidates[-1]
+
+    # Model selection across explicit + tuned (selectModels role,
+    # GameTrainingDriver.scala:701-766): EXPLICIT/TUNED restrict the pool.
+    if args.output_mode == "EXPLICIT":
+        best = _select(results)
+    elif args.output_mode == "TUNED":
+        best = _select(tuned_results)
+        if best is None:
+            raise ValueError(
+                "--output-mode TUNED requires --hyper-parameter-tuning with "
+                "at least one successful tuning iteration"
             )
-    if args.output_mode in ("BEST", "ALL"):
+    else:
+        best = _select(results + tuned_results)
+
+    for key, pool in (("configs", results), ("tuned_configs", tuned_results)):
+        for i, r in enumerate(pool):
+            summary[key].append({"config": r.config.describe(), "metrics": r.metrics})
+            if args.output_mode == "ALL":
+                save_game_model(
+                    r.model,
+                    os.path.join(args.output_dir, "models", f"{key}-{i}"),
+                    index_maps, entity_indexes,
+                )
+    if args.output_mode != "NONE":
         save_game_model(
             best.model, os.path.join(args.output_dir, "best"),
             index_maps, entity_indexes,
@@ -188,6 +236,56 @@ def run(args) -> Dict:
     with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
     return summary
+
+
+def _run_hyperparameter_tuning(args, estimator, results, batch, valid_batch, suite):
+    """Bayesian/random search over regularization hyperparameters, seeded
+    with the explicit grid as prior observations."""
+    import logging
+
+    from photon_tpu.estimators.evaluation_function import (
+        GameEstimatorEvaluationFunction,
+    )
+    from photon_tpu.hyperparameter.serialization import observations_to_json
+    from photon_tpu.hyperparameter.tuner import TunerName, TuningMode, get_tuner
+
+    logger = logging.getLogger("photon_tpu.driver")
+    if valid_batch is None or suite is None:
+        raise ValueError(
+            "--hyper-parameter-tuning requires --validation-paths and "
+            "--evaluators (the tuner optimizes the primary validation metric)"
+        )
+    base_config = results[0].config
+    is_opt_max = suite.primary.better()(1.0, 0.0)
+    fn = GameEstimatorEvaluationFunction(
+        estimator, base_config, batch, valid_batch, suite, is_opt_max
+    )
+    if fn.dim == 0:
+        logger.warning(
+            "hyperparameter tuning requested but no coordinate is "
+            "regularized in the base configuration; skipping"
+        )
+        return []
+    tuner = get_tuner(TunerName[args.hyper_parameter_tuner])
+    with Timed(f"driver/hyperparameter-tuning[{args.hyper_parameter_tuning}]"):
+        _best_x, _best_v, observations = tuner.search(
+            args.hyper_parameter_tuning_iter,
+            fn.dim,
+            TuningMode[args.hyper_parameter_tuning],
+            fn,
+            search_range=fn.search_range,
+            prior_observations=fn.convert_observations(results),
+        )
+    os.makedirs(args.output_dir, exist_ok=True)
+    with open(
+        os.path.join(args.output_dir, "hyperparameter-observations.json"), "w"
+    ) as f:
+        f.write(observations_to_json(observations, fn.names))
+    logger.info(
+        "hyperparameter tuning: %d candidates evaluated, observations saved",
+        len(fn.results),
+    )
+    return fn.results
 
 
 def main(argv=None):

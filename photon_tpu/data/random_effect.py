@@ -70,6 +70,12 @@ class RandomEffectDataConfig:
     active_lower_bound: Optional[int] = None  # lower bound on #samples/entity
     features_to_samples_ratio: Optional[float] = None  # Pearson selection cap
     n_buckets: int = 4  # blocks with distinct n_max to bound padding waste
+    # Per-block feature-subspace compaction (reference
+    # LinearSubspaceProjector.scala:36-88 / RandomEffectDataset.scala:383-432,
+    # vmap-granularity: the union of a BLOCK's active columns instead of one
+    # projector per entity). None = auto: on for sparse shard input, off for
+    # dense. Blocks store a ``col_map`` back to the global feature space.
+    subspace_projection: Optional[bool] = None
 
 
 @jax.tree_util.register_dataclass
@@ -92,6 +98,10 @@ class EntityBlock:
     weight: Array
     sample_index: Array
     train_mask: Array
+    # Subspace projection (LinearSubspaceProjector role): block-local feature
+    # column j corresponds to global column col_map[j]. None = identity
+    # (block dim == shard dim).
+    col_map: Optional[Array] = None
 
     @property
     def num_entities(self) -> int:
@@ -103,7 +113,23 @@ class EntityBlock:
 
     @property
     def dim(self) -> int:
+        """Block-local feature dimension (≤ shard dim under projection)."""
         return self.features.shape[2]
+
+    def project_backward(self, w_block: Array, d_full: int) -> Array:
+        """Block-space coefficients (E, dim) → global space (E, d_full)
+        (reference LinearSubspaceProjector.projectBackward)."""
+        if self.col_map is None:
+            return w_block
+        out = jnp.zeros((w_block.shape[0], d_full), w_block.dtype)
+        return out.at[:, self.col_map].set(w_block)
+
+    def project_forward(self, w_global: Array) -> Array:
+        """Global-space coefficients (E, d_full) → block space (E, dim)
+        (reference LinearSubspaceProjector.projectForward)."""
+        if self.col_map is None:
+            return w_global
+        return w_global[:, self.col_map]
 
     def gather_offsets(self, offsets: Array) -> Array:
         """(E, n_max) per-sample offsets from the flat (n,) offset/residual
@@ -114,7 +140,10 @@ class EntityBlock:
 
 @dataclasses.dataclass
 class RandomEffectDataset:
-    """All blocks for one random-effect coordinate + bookkeeping."""
+    """All blocks for one random-effect coordinate + bookkeeping.
+
+    ``dim`` is the GLOBAL shard dimension; under subspace projection each
+    block's local dim (``block.dim``) may be far smaller."""
 
     config: RandomEffectDataConfig
     blocks: List[EntityBlock]
@@ -125,10 +154,33 @@ class RandomEffectDataset:
     def num_active_samples(self) -> int:
         return int(sum(np.sum(np.asarray(b.weight) > 0) for b in self.blocks))
 
+    @property
+    def projected(self) -> bool:
+        return any(b.col_map is not None for b in self.blocks)
+
+    def projection_tables(self):
+        """(entity_block, entity_row, inv_maps) for ProjectedRandomEffectModel:
+        entity e's model lives at row entity_row[e] of block entity_block[e]
+        (−1 = entity has no data); inv_maps[b] maps global→block columns."""
+        entity_block = np.full((self.num_entities,), -1, np.int32)
+        entity_row = np.zeros((self.num_entities,), np.int32)
+        inv_maps = []
+        for b, block in enumerate(self.blocks):
+            eidx = np.asarray(block.entity_idx)
+            entity_block[eidx] = b
+            entity_row[eidx] = np.arange(eidx.size, dtype=np.int32)
+            inv = np.full((self.dim,), -1, np.int32)
+            if block.col_map is not None:
+                inv[np.asarray(block.col_map)] = np.arange(block.dim, dtype=np.int32)
+            else:
+                inv = np.arange(self.dim, dtype=np.int32)
+            inv_maps.append(jnp.asarray(inv))
+        return jnp.asarray(entity_block), jnp.asarray(entity_row), inv_maps
+
 
 def build_random_effect_dataset(
     entity_ids: np.ndarray,  # (n,) dense int32 entity index per sample
-    features: np.ndarray,  # (n, d) dense shard features
+    features,  # (n, d) dense np array OR host sparse (indices, values, dim)
     label: np.ndarray,
     weight: np.ndarray,
     num_entities: int,
@@ -141,8 +193,29 @@ def build_random_effect_dataset(
     Samples per entity beyond ``active_upper_bound`` are dropped from active
     training data via deterministic reservoir sampling (they remain passive:
     still scored through the flat batch).
+
+    ``features`` is either a dense (n, d) array or a host-side padded-sparse
+    triple ``(indices (n,k) int, values (n,k) float, dim)`` — the wide-shard
+    route. Sparse input implies per-block subspace projection (compacting
+    each block to the union of its entities' active columns, reference
+    RandomEffectDataset.scala:383-432); dense input opts in via
+    ``config.subspace_projection=True``.
     """
-    n, d = features.shape
+    sp_indices = sp_values = None
+    if isinstance(features, tuple):
+        sp_indices, sp_values, d = features
+        sp_indices = np.asarray(sp_indices)
+        sp_values = np.asarray(sp_values)
+        n = sp_indices.shape[0]
+        project = True if config.subspace_projection is None else config.subspace_projection
+        if not project:
+            raise ValueError("sparse shard input requires subspace projection")
+        feat_dtype = sp_values.dtype
+    else:
+        features = np.asarray(features)
+        n, d = features.shape
+        project = bool(config.subspace_projection)
+        feat_dtype = features.dtype
     uid = np.arange(n, dtype=np.int64) if uid is None else uid.astype(np.int64)
 
     # Group sample rows by entity (sorted for determinism).
@@ -188,7 +261,26 @@ def build_random_effect_dataset(
             continue
         n_max = int(max(n_max, 1))
         E = sel.size
-        feat = np.zeros((E, n_max, d), dtype=features.dtype)
+        block_rows = np.concatenate([entities[gi][1] for gi in sel])
+
+        # Subspace compaction: block feature space = union of active columns
+        # (LinearSubspaceProjector per vmap block instead of per entity).
+        col_map = inv_map = None
+        if project:
+            if sp_indices is not None:
+                active = sp_indices[block_rows][sp_values[block_rows] != 0]
+                col_map = np.unique(active).astype(np.int64)
+            else:
+                col_map = np.flatnonzero(
+                    np.any(features[block_rows] != 0, axis=0)
+                ).astype(np.int64)
+            if col_map.size == 0:
+                col_map = np.zeros((1,), np.int64)  # degenerate all-zero block
+            inv_map = np.full((d,), -1, dtype=np.int64)
+            inv_map[col_map] = np.arange(col_map.size)
+        d_block = int(col_map.size) if project else d
+
+        feat = np.zeros((E, n_max, d_block), dtype=feat_dtype)
         lab = np.zeros((E, n_max), dtype=label.dtype)
         wt = np.zeros((E, n_max), dtype=weight.dtype)
         sidx = np.full((E, n_max), -1, dtype=np.int32)
@@ -197,7 +289,17 @@ def build_random_effect_dataset(
         for j, gi in enumerate(sel):
             eid, rows = entities[gi]
             m = len(rows)
-            feat[j, :m] = features[rows]
+            if sp_indices is not None:
+                # Scatter padded-sparse rows into the compact block space.
+                loc = inv_map[sp_indices[rows]]  # (m, k), −1 only for 0-values
+                vals = sp_values[rows]
+                keep = vals != 0
+                r_i, _k_i = np.nonzero(keep)
+                np.add.at(feat[j], (r_i, loc[keep]), vals[keep])
+            elif project:
+                feat[j, :m] = features[rows][:, col_map]
+            else:
+                feat[j, :m] = features[rows]
             lab[j, :m] = label[rows]
             wt[j, :m] = weight[rows]
             sidx[j, :m] = rows
@@ -211,6 +313,7 @@ def build_random_effect_dataset(
                 weight=jnp.asarray(wt),
                 sample_index=jnp.asarray(sidx),
                 train_mask=jnp.asarray(tmask),
+                col_map=None if col_map is None else jnp.asarray(col_map, jnp.int32),
             )
         )
     return RandomEffectDataset(config, blocks, num_entities, d)

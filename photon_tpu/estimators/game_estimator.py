@@ -149,9 +149,25 @@ class GameEstimator:
 
     def _prepare_datasets(self, batch: GameBatch) -> None:
         """Random-effect grouping happens once per fit() — the λ sweep
-        reuses the blocks (the reference rebuilds per config; we don't)."""
+        reuses the blocks (the reference rebuilds per config; we don't).
+        Repeated fits on the SAME batch (hyperparameter tuning calls fit
+        once per candidate) reuse the previous grouping."""
+        if getattr(self, "_prepared_for", None) is batch:
+            return
         self._re_datasets = {}
-        feats_np = {k: np.asarray(v) for k, v in batch.features.items()}
+        from photon_tpu.data.batch import SparseFeatures
+
+        # Sparse (wide) shards pass through as host triples — the builder
+        # compacts each block to its active-column subspace instead of
+        # densifying the full shard width.
+        feats_np = {
+            k: (
+                (np.asarray(v.indices), np.asarray(v.values), v.dim)
+                if isinstance(v, SparseFeatures)
+                else np.asarray(v)
+            )
+            for k, v in batch.features.items()
+        }
         label_np = np.asarray(batch.label)
         weight_np = np.asarray(batch.weight)
         for cfg in self.coordinate_configs:
@@ -173,6 +189,7 @@ class GameEstimator:
                     ),
                     uid=None if batch.uid is None else np.asarray(batch.uid),
                 )
+        self._prepared_for = batch
 
     # --- fit ---
 

@@ -24,10 +24,14 @@ class GameTransformer:
     def __init__(self, model: GameModel, evaluation_suite: Optional[EvaluationSuite] = None):
         self.model = model
         self.evaluation_suite = evaluation_suite
+        # Model passed as an argument so repeated transforms (same batch
+        # shapes) reuse one compiled program instead of retracing against a
+        # fresh model-closure every call.
+        self._score = jax.jit(lambda model, batch: model.score_with_offset(batch))
 
     def transform(self, batch: GameBatch) -> Array:
         """Per-sample total scores (model + offsets), jitted."""
-        scores = jax.jit(self.model.score_with_offset)(batch)
+        scores = self._score(self.model, batch)
         if self.evaluation_suite is not None:
             metrics = self.evaluation_suite.evaluate_scores(scores, batch)
             logger.info("scoring evaluation: %s", metrics)

@@ -22,7 +22,12 @@ from photon_tpu.data.index_map import EntityIndex, IndexMap
 from photon_tpu.io.avro import read_avro_records, write_avro_records
 from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_SCHEMA
 from photon_tpu.models.coefficients import Coefficients
-from photon_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    ProjectedRandomEffectModel,
+    RandomEffectModel,
+)
 from photon_tpu.models.glm import GeneralizedLinearModel
 from photon_tpu.ops.losses import loss_for_task
 from photon_tpu.types import TaskType
@@ -167,6 +172,65 @@ def save_game_model(
                 "task": sub.task.value,
                 "dim": int(coefs.shape[1]),
                 "numEntities": int(coefs.shape[0]),
+            }
+        elif isinstance(sub, ProjectedRandomEffectModel):
+            # Wide-shard path: iterate blocks, translate block-local columns
+            # to global names through col_map — the (E, d_full) matrix is
+            # never materialized (ModelProjection.projectBackward role,
+            # performed per nonzero coefficient at write time).
+            cdir = os.path.join(output_dir, RANDOM_DIR, cid)
+            os.makedirs(cdir, exist_ok=True)
+            with open(os.path.join(cdir, ID_INFO_FILE), "w") as f:
+                f.write(sub.re_type)
+            imap = index_maps[sub.feature_shard]
+            eidx = entity_indexes.get(sub.re_type)
+            entity_block = np.asarray(sub.entity_block)
+            entity_row = np.asarray(sub.entity_row)
+            records = []
+            for e in range(sub.num_entities):
+                b = int(entity_block[e])
+                if b < 0:
+                    continue  # entity never seen: no model row
+                cmap = np.asarray(sub.col_maps[b])
+                w = np.asarray(sub.block_coefs[b][int(entity_row[e])])
+                v = (
+                    None
+                    if sub.block_variances is None
+                    else np.asarray(sub.block_variances[b][int(entity_row[e])])
+                )
+                model_id = eidx.entity_id(e) if eidx is not None else str(e)
+                rows, var_rows = [], [] if v is not None else None
+                for j in np.flatnonzero(np.abs(w) > sparsity_threshold):
+                    key = imap.get_feature_name(int(cmap[j]))
+                    if key is None:
+                        continue
+                    name, term = _split_key(key)
+                    rows.append({"name": name, "term": term, "value": float(w[j])})
+                    if var_rows is not None:
+                        var_rows.append(
+                            {"name": name, "term": term, "value": float(v[j])}
+                        )
+                records.append(
+                    {
+                        "modelId": model_id,
+                        "modelClass": _MODEL_CLASS[sub.task],
+                        "means": rows,
+                        "variances": var_rows,
+                        "lossFunction": loss_for_task(sub.task).name,
+                    }
+                )
+            write_avro_records(
+                os.path.join(cdir, "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL_SCHEMA,
+                records,
+            )
+            meta["coordinates"][cid] = {
+                "type": "random",
+                "reType": sub.re_type,
+                "featureShard": sub.feature_shard,
+                "task": sub.task.value,
+                "dim": int(sub.d_full),
+                "numEntities": int(sub.num_entities),
             }
         else:
             raise TypeError(f"unknown submodel type {type(sub)}")

@@ -79,7 +79,94 @@ class RandomEffectModel:
         return jnp.where(valid, scores, 0.0)
 
 
-DatumScoringModel = Union[FixedEffectModel, RandomEffectModel]
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ProjectedRandomEffectModel:
+    """Per-entity GLMs kept in per-block SUBSPACES (the wide-shard form).
+
+    Role parity: reference RandomEffectModel + ModelProjection — per-entity
+    models live in each entity's compact feature subspace and are projected
+    back to the global space on demand (projector/LinearSubspaceProjector
+    .scala:36-88, algorithm/ModelProjection.scala). Here the subspace is per
+    vmap BLOCK (union of the block's active columns): coefficients are a
+    list of (E_b, d_b) matrices + int32 column maps into the global space,
+    so a shard of width d_full never materializes (E, d_full) HBM.
+
+    entity_block/entity_row: (E_total,) int32 — which block (−1 = no data;
+    scores 0) and which row within it holds each entity's model.
+    inv_maps[b]: (d_full,) int32 — global column → block column (−1 absent).
+    """
+
+    block_coefs: list  # [(E_b, d_b)]
+    col_maps: list  # [(d_b,) int32 global column ids]
+    inv_maps: list  # [(d_full,) int32]
+    entity_block: Array  # (E_total,)
+    entity_row: Array  # (E_total,)
+    d_full: int = dataclasses.field(metadata=dict(static=True))
+    re_type: str = dataclasses.field(metadata=dict(static=True))
+    feature_shard: str = dataclasses.field(metadata=dict(static=True))
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+    block_variances: Optional[list] = None
+
+    @property
+    def num_entities(self) -> int:
+        return self.entity_block.shape[0]
+
+    def score(self, batch: GameBatch) -> Array:
+        """Gather-by-entity scoring without leaving block space: each
+        sample's feature columns are translated through its entity's block
+        inverse map; absent columns contribute 0 (the entity never saw that
+        feature — its coefficient is implicitly 0)."""
+        idx = batch.entity_ids[self.re_type]
+        valid = idx >= 0
+        safe = jnp.where(valid, idx, 0)
+        blk = self.entity_block[safe]  # (n,)
+        row = self.entity_row[safe]
+        feats = batch.features[self.feature_shard]
+        total = jnp.zeros((idx.shape[0],), jnp.float32)
+        for b, (coefs, inv) in enumerate(zip(self.block_coefs, self.inv_maps)):
+            in_b = valid & (blk == b)
+            row_b = jnp.where(in_b, row, 0)
+            w = coefs[row_b]  # (n, d_b)
+            if isinstance(feats, SparseFeatures):
+                loc = inv[feats.indices]  # (n, k) block-local columns
+                gathered = jnp.take_along_axis(w, jnp.maximum(loc, 0), axis=1)
+                s = jnp.sum(
+                    jnp.where(loc >= 0, feats.values * gathered, 0.0), axis=-1
+                )
+            else:
+                s = jnp.einsum(
+                    "nd,nd->n", feats[:, self.col_maps[b]].astype(w.dtype), w
+                )
+            total = total + jnp.where(in_b, s, 0.0)
+        return total
+
+    def to_dense(self) -> RandomEffectModel:
+        """Materialize the global-space (E, d_full) model (small shards,
+        tests, interoperability). The wide-shard I/O path iterates blocks
+        directly instead (io/model_io.py)."""
+        coefs = jnp.zeros((self.num_entities, self.d_full), jnp.float32)
+        variances = None
+        for b, (wb, cmap) in enumerate(zip(self.block_coefs, self.col_maps)):
+            rows = jnp.flatnonzero(self.entity_block == b, size=wb.shape[0])
+            coefs = coefs.at[rows[:, None], cmap[None, :]].set(
+                wb[self.entity_row[rows]]
+            )
+        if self.block_variances is not None:
+            variances = jnp.ones((self.num_entities, self.d_full), jnp.float32)
+            for b, (vb, cmap) in enumerate(
+                zip(self.block_variances, self.col_maps)
+            ):
+                rows = jnp.flatnonzero(self.entity_block == b, size=vb.shape[0])
+                variances = variances.at[rows[:, None], cmap[None, :]].set(
+                    vb[self.entity_row[rows]]
+                )
+        return RandomEffectModel(
+            coefs, self.re_type, self.feature_shard, self.task, variances
+        )
+
+
+DatumScoringModel = Union[FixedEffectModel, RandomEffectModel, ProjectedRandomEffectModel]
 
 
 @jax.tree_util.register_dataclass

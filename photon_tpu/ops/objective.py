@@ -111,6 +111,19 @@ class GLMObjective:
         feats = batch.features
         if isinstance(feats, SparseFeatures) or feats.shape[1] > MAX_FUSED_DIM:
             return False
+        # A pallas_call on a batch sharded over the mesh's data axis would
+        # gather X to one device, silently defeating the data-parallel path
+        # — require single-device data where the placement is visible
+        # (concrete arrays). Sharded entry points must strip use_pallas
+        # (glmix_sharded_train_step does) or shard_map around the solver.
+        if isinstance(feats, jax.Array) and not isinstance(
+            feats, jax.core.Tracer
+        ):
+            try:
+                if len(feats.sharding.device_set) > 1:
+                    return False
+            except Exception:  # pragma: no cover - sharding introspection
+                return False
         norm = self.normalization
         return norm is None or norm.shifts is None
 

@@ -49,7 +49,7 @@ MAX_FUSED_DIM = 4096
 
 
 def _kernel(loss: PointwiseLoss, w_ref, x_ref, y_ref, off_ref, wt_ref,
-            loss_ref, grad_ref):
+            loss_ref, grad_ref, z_ref=None):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -60,6 +60,10 @@ def _kernel(loss: PointwiseLoss, w_ref, x_ref, y_ref, off_ref, wt_ref,
     # All values kept rank-2 (Mosaic-friendly layouts; scalar/1-D reductions
     # with accumulation fail to lower — "Offset change").
     z = jnp.dot(x, w_ref[:], preferred_element_type=jnp.float32) + off_ref[:]
+    if z_ref is not None:
+        # Fresh margins out — lets margin-space solvers refresh their carried
+        # margins exactly (no incremental z += α·u drift) at no extra X pass.
+        z_ref[:] = z
     y = y_ref[:]
     wt = wt_ref[:]
 
@@ -94,7 +98,8 @@ def fused_data_value_and_grad(
     weight: Array,
     tile_n: int = DEFAULT_TILE_N,
     interpret: Optional[bool] = None,
-) -> Tuple[Array, Array]:
+    return_margins: bool = False,
+) -> Tuple[Array, ...]:
     """Σᵢ wᵢ·loss(xᵢ·w + offsetᵢ, yᵢ) and its gradient w.r.t. ``w``, in one
     pass over ``X``. Pure data term — no regularization, no normalization.
 
@@ -104,6 +109,11 @@ def fused_data_value_and_grad(
 
     ``X`` may be bfloat16 (half the HBM traffic of the bandwidth-bound read);
     margins and all accumulation stay float32 via preferred_element_type.
+
+    With ``return_margins=True`` also returns the fresh margins
+    ``z = X·w + offset`` (float32, shape (n,)) computed in the same pass —
+    the margin-space L-BFGS uses this to refresh its carried margins exactly
+    every iteration instead of accumulating ``z += α·u`` rounding drift.
     """
     n, d = X.shape
     if interpret is None:
@@ -131,7 +141,20 @@ def fused_data_value_and_grad(
     col = lambda v: v.astype(jnp.float32)[:, None]
 
     n_tiles = n_pad // tile_n
-    loss_out, grad_out = pl.pallas_call(
+    out_specs = [
+        # Full-array resident block; each step stores its own row.
+        pl.BlockSpec((n_tiles, 1), lambda i: (0, 0)),
+        pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((n_tiles, 1), jnp.float32),
+        jax.ShapeDtypeStruct((d_pad, 1), jnp.float32),
+    ]
+    if return_margins:
+        out_specs.append(pl.BlockSpec((tile_n, 1), lambda i: (i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((n_pad, 1), jnp.float32))
+
+    outs = pl.pallas_call(
         functools.partial(_kernel, loss),
         grid=(n_tiles,),
         in_specs=[
@@ -141,20 +164,16 @@ def fused_data_value_and_grad(
             pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),          # offset
             pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),          # weight
         ],
-        out_specs=[
-            # Full-array resident block; each step stores its own row.
-            pl.BlockSpec((n_tiles, 1), lambda i: (0, 0)),
-            pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_tiles, 1), jnp.float32),
-            jax.ShapeDtypeStruct((d_pad, 1), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(w2, X, col(label), col(offset), col(weight))
 
+    loss_out, grad_out = outs[0], outs[1]
     value = jnp.sum(loss_out)
     grad = grad_out[:, 0]
     if d_pad != d:
         grad = grad[:d]
+    if return_margins:
+        return value, grad, outs[2][:n, 0]
     return value, grad

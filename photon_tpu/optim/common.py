@@ -78,9 +78,21 @@ class OptimizeResult:
     reason_code: Array
     loss_history: Array
     grad_norm_history: Array
-    # Total objective (value+grad) evaluations, including line-search trials —
-    # the cost unit for throughput accounting (each eval is one full data pass).
+    # Work counter for throughput accounting. Its unit is ``eval_unit``:
+    # black-box solvers (LBFGS/OWL-QN/LBFGS-B/TRON) count objective
+    # evaluations including line-search trials ("objective_evals", each = 2
+    # feature-matrix passes); margin-space L-BFGS and Newton count
+    # feature-matrix passes directly ("x_passes"). Consumers aggregating
+    # across solvers must check the unit (bench.py normalizes to passes).
     evals: Array = dataclasses.field(default_factory=lambda: jnp.zeros((), jnp.int32))
+    eval_unit: str = dataclasses.field(
+        default="objective_evals", metadata=dict(static=True)
+    )
+
+    @property
+    def x_passes(self) -> Array:
+        """``evals`` normalized to feature-matrix passes (the bench unit)."""
+        return self.evals * (2 if self.eval_unit == "objective_evals" else 1)
 
     @property
     def converged(self) -> bool:

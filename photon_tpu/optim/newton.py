@@ -1,0 +1,220 @@
+"""Damped Newton (Levenberg) solver for small-dimension GLMs.
+
+Role parity: the per-entity random-effect solves — the reference runs one
+Breeze L-BFGS per entity inside ``mapValues``
+(photon-api algorithm/RandomEffectCoordinate.scala:228-283), and offers TRON
+(truncated Newton, photon-lib optimization/TRON.scala:148-246) as the
+second-order option.
+
+TPU-first design: for the random-effect shape (d ≲ 64, thousands of
+entities solved as ONE vmapped program) the right second-order method is
+exact Newton with a batched Cholesky — H = XᵀDX + λI is a tiny (d, d)
+matrix whose assembly is an MXU einsum and whose factorization is cheap,
+while L-BFGS's nested line-search loops dominate wall time on deep
+``lax.while_loop`` nests (each vmapped while iteration costs fixed overhead
+regardless of lane width). Newton converges in 3-5 iterations where L-BFGS
+needs 10+, and each iteration is exactly TWO passes over X (one gradient
++ Hessian assembly, one trial-point margin refresh) with no inner loops.
+
+Damping follows the Levenberg accept/reject pattern (the scalar analogue of
+TRON's trust-region radius update, TRON.scala:93-94): a rejected step keeps
+the iterate and multiplies the damping by 10; an accepted step shrinks it.
+A failed Cholesky (NaNs) lands in the reject branch by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.common import (
+    OptimizeResult,
+    OptimizerConfig,
+    REASON_MAX_ITERATIONS,
+    REASON_NOT_CONVERGED,
+    check_convergence,
+)
+
+Array = jax.Array
+
+_MU_INIT = 0.0  # start with pure Newton; L2'd GLM Hessians are PD
+_MU_BOOST = 10.0
+_MU_SHRINK = 0.25
+_MU_MIN_ON_REJECT = 1e-4  # first reject jumps 0 → 1e-3 (×10 applied after)
+
+
+def minimize_newton(
+    objective: GLMObjective,
+    batch: LabeledBatch,
+    w0: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    l2_override: Optional[Array] = None,
+) -> OptimizeResult:
+    """Levenberg-damped exact Newton over a dense-feature GLM batch.
+
+    ``result.evals`` counts X passes (2 per iteration), the same cost unit
+    as ``minimize_lbfgs_margin``. Dense features only (the per-entity blocks
+    are dense by construction); scale-type normalization is folded, shift
+    normalization is not supported (the random-effect path never uses it).
+    """
+    if isinstance(batch.features, SparseFeatures):
+        raise ValueError("minimize_newton requires dense features")
+    if objective.l1_weight > 0.0:
+        raise ValueError("Newton solves smooth objectives; use OWL-QN for L1")
+    norm = objective.normalization
+    if norm is not None and not norm.is_identity and norm.shifts is not None:
+        raise ValueError("minimize_newton supports scale normalization only")
+
+    loss = objective.loss
+    l2 = objective.l2_weight if l2_override is None else l2_override
+    has_l2 = l2_override is not None or objective.l2_weight != 0.0
+    label, weight, offset = batch.label, batch.weight, batch.offset
+    X = batch.features
+    if norm is not None and not norm.is_identity and norm.factors is not None:
+        X = X * norm.factors[None, :]  # margins/H/grad all use X·diag(f)
+
+    d = w0.shape[0]
+    dtype = w0.dtype
+    m_iter, tol = config.max_iter, config.tol
+
+    def _l2_mask(w: Array) -> Array:
+        if objective.intercept_index is None:
+            return w
+        return w.at[objective.intercept_index].set(0.0)
+
+    def l2_value(w: Array) -> Array:
+        if not has_l2:
+            return jnp.zeros((), dtype)
+        wm = _l2_mask(w)
+        return 0.5 * l2 * jnp.dot(wm, wm)
+
+    def data_value(z: Array) -> Array:
+        return jnp.sum(weight * loss.value(z, label))
+
+    lam_diag = jnp.zeros((d,), dtype)
+    if has_l2:
+        lam_diag = jnp.full((d,), l2, dtype)
+        if objective.intercept_index is not None:
+            lam_diag = lam_diag.at[objective.intercept_index].set(0.0)
+
+    z0 = X @ w0 + offset
+    f0 = data_value(z0) + l2_value(w0)
+
+    hist_len = config.history_len
+    state0 = dict(
+        w=w0,
+        z=z0,
+        f=f0,
+        mu=jnp.asarray(_MU_INIT, dtype),
+        gnorm=jnp.asarray(jnp.inf, dtype),
+        it=jnp.int32(0),
+        reason=jnp.int32(REASON_NOT_CONVERGED),
+        evals=jnp.int32(1),  # initial margin pass
+        g0_norm=jnp.asarray(0.0, dtype),
+        loss_hist=jnp.full((hist_len,), f0, dtype),
+        gnorm_hist=jnp.full((hist_len,), jnp.inf, dtype),
+    )
+
+    def cond(st):
+        return (st["reason"] == REASON_NOT_CONVERGED) & (st["it"] < m_iter)
+
+    def body(st):
+        w, z, f = st["w"], st["z"], st["f"]
+        # --- pass 1: gradient + Hessian from the carried margins ---
+        dz = weight * loss.dz(z, label)
+        d2 = weight * loss.dzz(z, label)
+        g = X.T @ dz + (l2 * _l2_mask(w) if has_l2 else 0.0)
+        H = jnp.einsum("nd,n,ne->de", X, d2, X) + jnp.diag(lam_diag)
+        gnorm = jnp.linalg.norm(g)
+        g0_norm = jnp.where(st["it"] == 0, gnorm, st["g0_norm"])
+
+        # Levenberg system: (H + μ·diag(H)) p = -g. Scaling the damping by
+        # diag(H) keeps μ unit-free across entities of very different sizes.
+        Hd = H + st["mu"] * jnp.diag(jnp.diagonal(H))
+        chol, _ = jax.scipy.linalg.cho_factor(Hd, lower=True)
+        p = -jax.scipy.linalg.cho_solve((chol, True), g)
+
+        # --- pass 2: trial margins, then FREE backtracking on margins ---
+        # Margins are affine in the step: z(w + t·p) = z + t·u with
+        # u = z_try − z already in hand, so step-halving trials are O(n)
+        # elementwise evaluations with no further X pass (the same margin
+        # affinity minimize_lbfgs_margin's line search exploits). This is
+        # what globalizes pure Newton on exp-like losses (Poisson) without
+        # burning a full iteration per rejected step.
+        w_try = w + p
+        z_try = X @ w_try + offset
+        u = z_try - z
+        ts = jnp.asarray([1.0, 0.5, 0.25, 0.125, 1 / 16, 1 / 32, 1 / 64], dtype)
+
+        def f_at(t):
+            return data_value(z + t * u) + l2_value(w + t * p)
+
+        fs = jax.vmap(f_at)(ts)
+        fs = jnp.where(jnp.isnan(fs), jnp.inf, fs)  # failed Cholesky → reject
+        ib = jnp.argmin(fs)
+        f_best, t_best = fs[ib], ts[ib]
+        # <= so ties at f32 resolution near the optimum still step (the
+        # gradient keeps contracting).
+        accept = f_best <= f
+
+        w_new = jnp.where(accept, w + t_best * p, w)
+        z_new = jnp.where(accept, z + t_best * u, z)
+        f_new = jnp.where(accept, f_best, f)
+        mu_new = jnp.where(
+            accept & (t_best == 1.0),
+            st["mu"] * _MU_SHRINK,
+            jnp.where(
+                accept,
+                st["mu"],  # partial step: keep current damping
+                jnp.maximum(st["mu"], _MU_MIN_ON_REJECT) * _MU_BOOST,
+            ),
+        )
+
+        it = st["it"] + 1
+        # Convergence: gradient test on the CURRENT iterate's exact gradient
+        # (no lag); value test on the best-trial-vs-current change — at the
+        # optimum even a rejected Newton step has |f_best − f| ≈ 0, which is
+        # precisely "can't improve" (a genuinely bad rejected step has a
+        # large |f_best − f| and keeps iterating with boosted damping).
+        reason = check_convergence(f_best, f, gnorm, g0_norm, tol, it, m_iter)
+        return dict(
+            w=w_new,
+            z=z_new,
+            f=f_new,
+            mu=mu_new,
+            gnorm=gnorm,
+            it=it,
+            reason=reason,
+            evals=st["evals"] + 2,
+            g0_norm=g0_norm,
+            loss_hist=st["loss_hist"].at[jnp.minimum(it, hist_len - 1)].set(f_new),
+            gnorm_hist=st["gnorm_hist"].at[jnp.minimum(it, hist_len - 1)].set(gnorm),
+        )
+
+    st = jax.lax.while_loop(cond, body, state0)
+    idx = jnp.arange(hist_len)
+    loss_hist = jnp.where(idx <= st["it"], st["loss_hist"], st["f"])
+    gnorm_hist = jnp.where(idx <= st["it"], st["gnorm_hist"], st["gnorm"])
+    # Entry 0 = |g| at the initial point (computed inside the first body
+    # iteration; inf only if the loop never ran).
+    gnorm_hist = gnorm_hist.at[0].set(
+        jnp.where(st["it"] > 0, st["g0_norm"], st["gnorm"])
+    )
+    reason = jnp.where(
+        st["reason"] == REASON_NOT_CONVERGED, REASON_MAX_ITERATIONS, st["reason"]
+    )
+    return OptimizeResult(
+        w=st["w"],
+        value=st["f"],
+        grad_norm=st["gnorm"],
+        iterations=st["it"],
+        reason_code=reason,
+        loss_history=loss_hist,
+        grad_norm_history=gnorm_hist,
+        evals=st["evals"],
+        eval_unit="x_passes",
+    )

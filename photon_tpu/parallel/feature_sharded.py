@@ -102,31 +102,37 @@ def sparse_value_and_grad_feature_sharded(
             f_gather = jnp.where(valid, factors_loc[local_idx], 0.0)
             vals = vals * f_gather
 
+        # All accumulation in float32 regardless of the feature-value dtype
+        # (bf16 values would otherwise degrade the margins, the gradient,
+        # and through them the L-BFGS curvature pairs — same
+        # preferred_element_type discipline as ops/pallas_glm).
         gathered = jnp.where(valid, w_loc[local_idx], 0.0)
-        z_partial = jnp.sum(vals * gathered, axis=-1)
+        z_partial = jnp.sum(
+            (vals * gathered).astype(jnp.float32), axis=-1
+        )
         z = jax.lax.psum(z_partial, FEATURE_AXIS) + offset
 
         lv = loss.value(z, label)
         dz = weight * loss.dz(z, label)
-        loss_local = jnp.sum(weight * lv)
+        loss_local = jnp.sum(weight * lv).astype(jnp.float32)
 
         # Scatter-add into the local coefficient range only.
-        contrib = jnp.where(valid, vals * dz[:, None], 0.0)
-        grad_loc = jnp.zeros((shard,), values.dtype).at[
+        contrib = jnp.where(valid, vals * dz[:, None], 0.0).astype(jnp.float32)
+        grad_loc = jnp.zeros((shard,), jnp.float32).at[
             local_idx.reshape(-1)
         ].add(contrib.reshape(-1))
         grad_loc = jax.lax.psum(grad_loc, dp)
 
         # L2 on the local shard; the (global) intercept is exempt.
         if l2 != 0.0:
-            wm = w_loc
+            wm = w_loc.astype(jnp.float32)
             if intercept is not None:
                 pos = jnp.arange(shard) + lo
                 wm = jnp.where(pos == intercept, 0.0, wm)
             grad_loc = grad_loc + l2 * wm
             l2_local = 0.5 * l2 * jnp.sum(wm * wm)
         else:
-            l2_local = jnp.zeros((), values.dtype)
+            l2_local = jnp.zeros((), jnp.float32)
 
         value = jax.lax.pmean(
             jax.lax.psum(loss_local, dp), FEATURE_AXIS

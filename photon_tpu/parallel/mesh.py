@@ -75,6 +75,20 @@ def make_multislice_mesh(
     assert per_slice % n_feature == 0, (per_slice, n_feature)
     # Slice-major ordering so each mesh row is one physical slice.
     devs = sorted(devs, key=lambda d: (getattr(d, "slice_index", 0), d.id))
+    # Every slice_index group must hold exactly per_slice devices — an
+    # uneven split would silently mix devices from different slices into
+    # one mesh row, putting DCN traffic on the (supposedly ICI) data axis.
+    slice_ids = [getattr(d, "slice_index", 0) for d in devs]
+    if len(set(slice_ids)) > 1:
+        from collections import Counter
+
+        counts = Counter(slice_ids)
+        assert len(counts) == n_slices and all(
+            c == per_slice for c in counts.values()
+        ), (
+            f"uneven slice membership {dict(counts)}: need {n_slices} slices "
+            f"of exactly {per_slice} devices each for a DCN-outer mesh"
+        )
     grid = np.asarray(devs).reshape(n_slices, per_slice // n_feature, n_feature)
     return Mesh(grid, (SLICE_AXIS, DATA_AXIS, FEATURE_AXIS))
 

@@ -193,3 +193,39 @@ def test_feature_indexing_driver(fixture_dir, tmp_path):
     assert len(nim) == 7
     assert nim.get_index("x0") >= 0
     nim.close()
+
+
+def test_game_training_hyperparameter_tuning(fixture_dir, tmp_path):
+    """BAYESIAN tuning on a deliberately-bad explicit grid must find a
+    better λ and TUNED output mode must save the tuned best."""
+    out = tmp_path / "tuned"
+    args = game_training.build_parser().parse_args(
+        [
+            "--input-paths", str(fixture_dir / "train.avro"),
+            "--validation-paths", str(fixture_dir / "valid.avro"),
+            "--output-dir", str(out),
+            "--feature-shard-configurations", "name=globalShard",
+            "--coordinate-configurations",
+            # Far-too-strong regularization: the grid underfits badly.
+            "name=global,feature.shard=globalShard,optimizer=LBFGS,reg.weights=2000|5000",
+            "--update-sequence", "global",
+            "--evaluators", "AUC",
+            "--hyper-parameter-tuning", "BAYESIAN",
+            "--hyper-parameter-tuning-iter", "6",
+            "--output-mode", "TUNED",
+        ]
+    )
+    summary = game_training.run(args)
+    assert len(summary["configs"]) == 2
+    assert len(summary["tuned_configs"]) == 6
+    best_grid = max(c["metrics"]["AUC"] for c in summary["configs"])
+    best_tuned = max(c["metrics"]["AUC"] for c in summary["tuned_configs"])
+    assert best_tuned > best_grid  # tuning beat the explicit grid
+    assert summary["best"]["metrics"]["AUC"] == best_tuned
+    assert (out / "best" / "model-metadata.json").exists()
+    # Search history persisted in prior-observation format.
+    obs_path = out / "hyperparameter-observations.json"
+    assert obs_path.exists()
+    records = json.loads(obs_path.read_text())["records"]
+    assert len(records) == 2 + 6  # grid priors + tuned candidates
+    assert all("global.weight" in r and "evaluationValue" in r for r in records)

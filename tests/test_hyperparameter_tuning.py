@@ -1,0 +1,195 @@
+"""Hyperparameter tuning end-to-end: estimator adapter, JSON serialization,
+and driver integration (reference GameEstimatorEvaluationFunction +
+runHyperparameterTuning + HyperparameterSerialization)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_tpu.estimators.config import (
+    GameOptimizationConfig,
+    RegularizationConfig,
+)
+from photon_tpu.hyperparameter.serialization import (
+    config_from_json,
+    observations_to_json,
+    prior_from_json,
+    transform_backward,
+    transform_forward,
+)
+from photon_tpu.hyperparameter.tuner import TuningMode
+
+
+# ---------- vectorization adapter ----------
+
+
+class _FakeSuite:
+    class _P:
+        name = "AUC"
+
+        def better(self):
+            return lambda a, b: a > b
+
+    primary = _P()
+
+
+class _FakeResult:
+    def __init__(self, config, metrics):
+        self.config = config
+        self.metrics = metrics
+
+
+class _FakeEstimator:
+    """Quadratic response surface: best AUC at log10 λ_g = 1, log10 λ_u = -1."""
+
+    def __init__(self):
+        self.calls = []
+
+    def fit(self, batch, validation_batch=None, evaluation_suite=None,
+            optimization_configs=None, **kw):
+        (config,) = optimization_configs
+        lg = np.log10(config.reg["global"].weight)
+        lu = np.log10(config.reg["perUser"].weight)
+        auc = 0.9 - 0.05 * (lg - 1.0) ** 2 - 0.05 * (lu + 1.0) ** 2
+        self.calls.append((lg, lu))
+        return [_FakeResult(config, {"AUC": auc})]
+
+
+def _base_config():
+    return GameOptimizationConfig(
+        {
+            "global": RegularizationConfig(weight=1.0),
+            "perUser": RegularizationConfig(weight=1.0),
+        }
+    )
+
+
+def test_config_vector_round_trip():
+    from photon_tpu.estimators.evaluation_function import (
+        GameEstimatorEvaluationFunction,
+    )
+
+    fn = GameEstimatorEvaluationFunction(
+        _FakeEstimator(), _base_config(), None, object(), _FakeSuite(), True
+    )
+    assert fn.dim == 2
+    assert fn.names == ["global.weight", "perUser.weight"]
+    cfg = GameOptimizationConfig(
+        {
+            "global": RegularizationConfig(weight=100.0),
+            "perUser": RegularizationConfig(weight=0.01),
+        }
+    )
+    x = fn.config_to_vector(cfg)
+    np.testing.assert_allclose(x, [2.0, -2.0])
+    back = fn.vector_to_config(x)
+    assert back.reg["global"].weight == pytest.approx(100.0)
+    assert back.reg["perUser"].weight == pytest.approx(0.01)
+
+
+def test_elastic_net_adds_alpha_dimension():
+    from photon_tpu.estimators.evaluation_function import (
+        GameEstimatorEvaluationFunction,
+    )
+
+    cfg = GameOptimizationConfig(
+        {
+            "global": RegularizationConfig(weight=1.0, alpha=0.5),
+            "locked": RegularizationConfig(weight=0.0),  # NONE: not tuned
+        }
+    )
+    fn = GameEstimatorEvaluationFunction(
+        _FakeEstimator(), cfg, None, object(), _FakeSuite(), True
+    )
+    assert fn.dim == 2  # log-weight + alpha; 'locked' contributes nothing
+    assert fn.names == ["global.weight", "global.alpha"]
+    back = fn.vector_to_config(np.asarray([0.0, 0.25]))
+    assert back.reg["global"].weight == pytest.approx(1.0)
+    assert back.reg["global"].alpha == pytest.approx(0.25)
+    assert back.reg["locked"].weight == 0.0
+
+
+def test_bayesian_search_beats_grid_on_surface():
+    """GP search on the fake response surface finds a better point than the
+    explicit grid corners it is seeded with."""
+    from photon_tpu.estimators.evaluation_function import (
+        GameEstimatorEvaluationFunction,
+    )
+    from photon_tpu.hyperparameter.tuner import AtlasTuner
+
+    est = _FakeEstimator()
+    fn = GameEstimatorEvaluationFunction(
+        est, _base_config(), None, object(), _FakeSuite(), is_opt_max=True
+    )
+    # Seed with a coarse explicit grid far from the optimum.
+    grid = [
+        _FakeResult(
+            GameOptimizationConfig(
+                {
+                    "global": RegularizationConfig(weight=10.0**a),
+                    "perUser": RegularizationConfig(weight=10.0**b),
+                }
+            ),
+            {"AUC": 0.9 - 0.05 * (a - 1.0) ** 2 - 0.05 * (b + 1.0) ** 2},
+        )
+        for a, b in [(-3.0, 3.0), (3.0, 3.0), (-3.0, -3.0)]
+    ]
+    priors = fn.convert_observations(grid)
+    assert len(priors) == 3
+    best_grid_auc = max(r.metrics["AUC"] for r in grid)
+    _x, best_v, obs = AtlasTuner().search(
+        12, fn.dim, TuningMode.BAYESIAN, fn,
+        search_range=fn.search_range, prior_observations=priors, seed=3,
+    )
+    tuned_auc = max(r.metrics["AUC"] for r in fn.results)
+    assert tuned_auc > best_grid_auc + 0.05
+    assert len(obs) == len(priors) + 12
+
+
+# ---------- JSON serialization ----------
+
+
+def test_config_from_json():
+    cfg = config_from_json(
+        json.dumps(
+            {
+                "tuning_mode": "BAYESIAN",
+                "variables": {
+                    "lambda": {"type": "DOUBLE", "min": 0.0001, "max": 10000.0,
+                               "transform": "LOG"},
+                    "alpha": {"type": "DOUBLE", "min": 0.0, "max": 1.0},
+                    "depth": {"type": "INT", "min": 1.0, "max": 5.0},
+                },
+            }
+        )
+    )
+    assert cfg.mode == TuningMode.BAYESIAN
+    assert cfg.names == ["lambda", "alpha", "depth"]
+    assert cfg.transforms == {0: "LOG"}
+    assert cfg.discrete == {2: 5}
+    np.testing.assert_allclose(cfg.lower, [0.0001, 0.0, 1.0])
+
+
+def test_transforms_round_trip():
+    x = np.asarray([100.0, 0.25, 9.0])
+    t = {0: "LOG", 2: "SQRT"}
+    fwd = transform_forward(x, t)
+    np.testing.assert_allclose(fwd, [2.0, 0.25, 3.0])
+    np.testing.assert_allclose(transform_backward(fwd, t), x)
+
+
+def test_prior_observations_round_trip():
+    obs = [(np.asarray([2.0, 0.5]), 0.85), (np.asarray([-1.0, 0.1]), 0.7)]
+    names = ["global.weight", "global.alpha"]
+    s = observations_to_json(obs, names)
+    parsed = prior_from_json(s, {}, names)
+    assert len(parsed) == 2
+    np.testing.assert_allclose(parsed[0][0], obs[0][0])
+    assert parsed[0][1] == pytest.approx(0.85)
+    # Missing params fall back to defaults.
+    partial = json.dumps(
+        {"records": [{"global.weight": "1.5", "evaluationValue": "0.6"}]}
+    )
+    parsed = prior_from_json(partial, {"global.alpha": 0.0}, names)
+    np.testing.assert_allclose(parsed[0][0], [1.5, 0.0])

@@ -93,3 +93,29 @@ def test_lbfgs_over_fused_objective():
         jnp.zeros(d, jnp.float32), cfg,
     )
     np.testing.assert_allclose(np.asarray(res_p.w), np.asarray(res_r.w), rtol=1e-3, atol=1e-4)
+
+
+def test_fused_return_margins():
+    import numpy as np
+    import jax.numpy as jnp
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.pallas_glm import fused_data_value_and_grad
+
+    rng = np.random.default_rng(21)
+    n, d = 300, 24  # non-tile-aligned on purpose
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    off = rng.normal(size=n).astype(np.float32) * 0.1
+    wt = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    val, grad, z = fused_data_value_and_grad(
+        LogisticLoss, jnp.asarray(w), jnp.asarray(X), jnp.asarray(y),
+        jnp.asarray(off), jnp.asarray(wt), return_margins=True,
+    )
+    np.testing.assert_allclose(np.asarray(z), X @ w + off, rtol=1e-5, atol=1e-5)
+    val2, grad2 = fused_data_value_and_grad(
+        LogisticLoss, jnp.asarray(w), jnp.asarray(X), jnp.asarray(y),
+        jnp.asarray(off), jnp.asarray(wt),
+    )
+    np.testing.assert_allclose(float(val), float(val2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(grad2), rtol=1e-6)
