@@ -276,6 +276,15 @@ def main():
     if "--measure-cpu-baseline" in sys.argv:
         measure_cpu_baseline()
         return
+    if "--measure-cpu-baseline-all" in sys.argv:
+        # Configs 1-3+5 CPU baselines (pin results in bench_configs.py).
+        from photon_tpu.utils.virtual_devices import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(1)
+        from bench_configs import measure_all_cpu_baselines
+
+        measure_all_cpu_baselines()
+        return
     results = [run_glmix_bench()]
     if "--all" in sys.argv:
         from bench_configs import run_extra_configs  # configs 1-3, BASELINE.md

@@ -43,12 +43,18 @@ from photon_tpu.optim.common import (
 )
 from photon_tpu.optim.lbfgs import minimize_lbfgs  # noqa: F401 (TRON/HVP paths)
 from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
+from photon_tpu.optim.newton import minimize_newton
 from photon_tpu.optim.tron import minimize_tron
 from photon_tpu.optim.owlqn import minimize_owlqn
 from photon_tpu.optim.factory import OptimizerSpec
 from photon_tpu.types import OptimizerType, TaskType
 
 Array = jax.Array
+
+# Widest per-entity dimension for which the default solver forms exact
+# (d, d) Hessians: above this, batched Newton's E·d² HBM footprint and d³
+# Cholesky cost lose to margin-LBFGS's d-linear iterations.
+NEWTON_AUTO_MAX_DIM = 128
 
 
 @jax.tree_util.register_dataclass
@@ -72,6 +78,31 @@ class RandomEffectTrackerStats:
         )
 
 
+def newton_eligible(
+    objective: GLMObjective, spec: OptimizerSpec, block_dim: int, has_mask: bool
+) -> bool:
+    """Static routing predicate for _solve_block: batched Newton serves
+    smooth, unmasked, shift-free problems — by default up to
+    NEWTON_AUTO_MAX_DIM, always under an explicit NEWTON spec."""
+    has_shifts = (
+        objective.normalization is not None
+        and not objective.normalization.is_identity
+        and objective.normalization.shifts is not None
+    )
+    return (
+        objective.l1_weight == 0.0
+        and not has_mask
+        and not has_shifts
+        and (
+            spec.optimizer == OptimizerType.NEWTON
+            or (
+                spec.optimizer == OptimizerType.LBFGS
+                and block_dim <= NEWTON_AUTO_MAX_DIM
+            )
+        )
+    )
+
+
 def _solve_block(
     block: EntityBlock,
     offsets: Array,  # (E, n_max) per-sample residual offsets
@@ -82,7 +113,19 @@ def _solve_block(
     feature_mask: Optional[Array] = None,  # (E, d) 0/1 Pearson mask
 ):
     """vmap one optimizer over all entities of a block. Returns (E, d) coefs +
-    per-entity (iterations, reason) for the tracker."""
+    per-entity (iterations, reason) for the tracker.
+
+    Solver routing (one production path — the same program bench.py measures):
+    L1 → OWL-QN; explicit TRON honored; otherwise smooth unmasked problems at
+    random-effect widths (d ≤ NEWTON_AUTO_MAX_DIM) run batched damped Newton
+    (optim/newton.py — 3-5 iterations of MXU Hessian assembly + Cholesky,
+    vs the reference's per-entity Breeze L-BFGS inside mapValues,
+    RandomEffectCoordinate.scala:228-283), with margin-space L-BFGS as the
+    wide-d / feature-masked / shift-normalized fallback.
+    """
+    use_newton = newton_eligible(
+        objective, spec, block.dim, has_mask=feature_mask is not None
+    )
 
     def solve_one(feat, lab, wt, off, w_init, fmask, tmask):
         lb = LabeledBatch(lab, feat, off, wt)
@@ -104,6 +147,8 @@ def _solve_block(
             if objective.intercept_index is not None:
                 l1_mask = jnp.ones_like(w_init).at[objective.intercept_index].set(0.0)
             res = minimize_owlqn(vg, w_init, objective.l1_weight, config, l1_mask)
+        elif use_newton:
+            res = minimize_newton(objective, lb, w_init, config)
         elif spec.optimizer == OptimizerType.TRON:
             res = minimize_tron(vg, hvp, w_init, config, spec.max_cg_iter)
         elif feature_mask is not None and (

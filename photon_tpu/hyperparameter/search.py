@@ -96,12 +96,13 @@ class RandomSearch:
 
 class GaussianProcessSearch(RandomSearch):
     """Bayesian search: GP posterior + EI argmax over Sobol candidates
-    (reference GaussianProcessSearch.scala:52-196; 250 candidates/round).
-    Falls back to pure Sobol until enough observations exist."""
+    (reference GaussianProcessSearch.scala:52-196 uses 250/round; 256 here —
+    Sobol sequences balance only at powers of two, and scipy warns
+    otherwise). Falls back to pure Sobol until enough observations exist."""
 
     def __init__(self, dim: int, evaluator: EvaluationFunction,
                  search_range: Optional[SearchRange] = None, seed: int = 1,
-                 num_candidates: int = 250, min_observations: int = 3,
+                 num_candidates: int = 256, min_observations: int = 3,
                  estimator: Optional[GaussianProcessEstimator] = None):
         super().__init__(dim, evaluator, search_range, seed)
         self.num_candidates = num_candidates

@@ -281,3 +281,35 @@ def minimize_lbfgs_margin(
         evals=st["evals"],
         eval_unit="x_passes",
     )
+
+
+def sweep_l2_lbfgs_margin(
+    objective: GLMObjective,
+    batch: LabeledBatch,
+    w0s: Array,  # (k, d) initial points, one per λ
+    l2_weights: Array,  # (k,)
+    config: OptimizerConfig = OptimizerConfig(),
+) -> OptimizeResult:
+    """Solve the SAME data against k regularization weights as ONE vmapped
+    program — the TPU replacement for the reference's sequential warm-started
+    λ sweep (ModelTraining.scala:162-200) and the parallel-candidate hook for
+    Bayesian tuning (SURVEY.md §2.7.5: hyperparameter parallelism, absent in
+    the reference).
+
+    Every lane streams the shared X through its own margin-space L-BFGS via
+    ``l2_override`` (a traced per-lane scalar), so the k solves cost one
+    X-bandwidth budget per iteration instead of k. Returns a batched
+    OptimizeResult whose leaves carry a leading (k,) axis.
+    """
+    import dataclasses
+
+    # The fused Pallas kernel doesn't batch under vmap the way the XLA path
+    # does (a batched pallas_call adds a grid axis instead of widening the
+    # matmul); the XLA path turns the k lane matvecs into ONE X·P matmul,
+    # which is exactly the bandwidth sharing this sweep exists for.
+    objective = dataclasses.replace(objective, use_pallas=False)
+
+    def solve(w0, l2):
+        return minimize_lbfgs_margin(objective, batch, w0, config, l2_override=l2)
+
+    return jax.vmap(solve)(w0s, l2_weights)

@@ -134,7 +134,14 @@ def minimize_newton(
 
         # Levenberg system: (H + μ·diag(H)) p = -g. Scaling the damping by
         # diag(H) keeps μ unit-free across entities of very different sizes.
-        Hd = H + st["mu"] * jnp.diag(jnp.diagonal(H))
+        # The diagonal is floored at a tiny fraction of its largest entry so
+        # a feature column with no active samples (H_jj = 0, arises when
+        # l2 = 0) still becomes positive-definite under damping instead of
+        # failing Cholesky forever — the dead direction then gets step
+        # p_j = −g_j/(μ·floor) = 0 since g_j = 0 too.
+        diag_h = jnp.diagonal(H)
+        floor = 1e-7 * jnp.maximum(jnp.max(diag_h), 1.0)
+        Hd = H + st["mu"] * jnp.diag(jnp.maximum(diag_h, floor))
         chol, _ = jax.scipy.linalg.cho_factor(Hd, lower=True)
         p = -jax.scipy.linalg.cho_solve((chol, True), g)
 

@@ -52,9 +52,10 @@ def _truncated_cg(
     delta: Array,
     max_cg_iter: int,
     cg_tol: Array,
-) -> Tuple[Array, Array]:
+) -> Tuple[Array, Array, Array]:
     """Solve min_s g·s + ½ sᵀHs  s.t. ‖s‖ ≤ delta by truncated CG
-    (Steihaug). Returns (step s, whether boundary was hit)."""
+    (Steihaug). Returns (step s, whether boundary was hit, #iterations —
+    each iteration costs one H·v product, counted by the caller)."""
     d = g.shape[0]
     s0 = jnp.zeros((d,), g.dtype)
     r0 = -g
@@ -88,10 +89,10 @@ def _truncated_cg(
         p_new = r_new + beta * p
         return s_new, r_new, p_new, it + 1, outside
 
-    s, r, _p, _it, hit = jax.lax.while_loop(
+    s, r, _p, it, hit = jax.lax.while_loop(
         cond, body, (s0, r0, p0, jnp.int32(0), jnp.bool_(False))
     )
-    return s, hit
+    return s, hit, it
 
 
 def minimize_tron(
@@ -134,7 +135,7 @@ def minimize_tron(
         w, f, g, delta = st["w"], st["f"], st["g"], st["delta"]
         gnorm = jnp.linalg.norm(g)
         cg_tol = 0.1 * gnorm
-        s, _hit = _truncated_cg(lambda v: hvp(w, v), g, delta, max_cg_iter, cg_tol)
+        s, _hit, cg_iters = _truncated_cg(lambda v: hvp(w, v), g, delta, max_cg_iter, cg_tol)
 
         w_trial = project_to_box(w + s, box)
         s_eff = w_trial - w
@@ -177,9 +178,14 @@ def minimize_tron(
                 jnp.int32(REASON_NOT_CONVERGED),
             ),
         )
+        # Work accounting: 1 value_and_grad at the trial point, plus one H·v
+        # per CG iteration and one for the ρ denominator — an H·v (jvp of
+        # grad) streams the data the same ~2 passes a value_and_grad does,
+        # so both count as one "objective_evals" unit (TRON.scala:287-326:
+        # each of these was a treeAggregate round).
         return dict(
             w=w_new, f=f_new, g=g_new, delta=delta_new, it=it, reason=reason,
-            evals=st["evals"] + 1,
+            evals=st["evals"] + 2 + cg_iters,
             loss_hist=st["loss_hist"].at[jnp.minimum(it, config.history_len - 1)].set(f_new),
             gnorm_hist=st["gnorm_hist"].at[jnp.minimum(it, config.history_len - 1)].set(gn),
         )

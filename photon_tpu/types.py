@@ -55,3 +55,7 @@ class OptimizerType(enum.Enum):
     OWLQN = "OWLQN"
     LBFGSB = "LBFGSB"
     TRON = "TRON"
+    # TPU-native addition (no reference analogue): batched damped Newton with
+    # exact (d, d) Cholesky solves — the natural second-order method for
+    # vmapped small-dimension random-effect solves (optim/newton.py).
+    NEWTON = "NEWTON"

@@ -153,3 +153,111 @@ def test_newton_rejects_sparse_and_l1():
             dense,
             jnp.zeros(3, jnp.float32),
         )
+
+
+def test_solve_block_routes_to_newton_and_matches_lbfgs():
+    """Default-spec RE block solves run batched Newton (the bench's solver —
+    VERDICT r2 #3: production path == benched path) and agree with the
+    margin-LBFGS fallback on the optimum."""
+    from photon_tpu.algorithm import random_effect as re_mod
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.optim.factory import OptimizerSpec
+    from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
+    from photon_tpu.types import OptimizerType
+
+    rng = np.random.default_rng(33)
+    N, E, d = 512, 16, 4
+    Xr = rng.normal(size=(N, d)).astype(np.float32)
+    Xr[:, 0] = 1.0
+    users = rng.integers(0, E, size=N).astype(np.int32)
+    y = (rng.uniform(size=N) < 0.5).astype(np.float32)
+    ds = build_random_effect_dataset(
+        users, Xr, y, np.ones(N, np.float32), E,
+        RandomEffectDataConfig(re_type="u", feature_shard="re", n_buckets=1),
+    )
+    (block,) = ds.blocks
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.5, intercept_index=0)
+    cfg = OptimizerConfig(max_iter=30, tol=1e-7, track_history=False)
+    offs = block.gather_offsets(jnp.zeros(N, jnp.float32))
+    w0 = jnp.zeros((block.num_entities, d), jnp.float32)
+
+    # Routing decision is static: default spec at d=4 must pick Newton.
+    assert d <= re_mod.NEWTON_AUTO_MAX_DIM
+    w_auto, _iters_auto, _ = re_mod._solve_block(
+        block, offs, w0, obj, OptimizerSpec(), cfg
+    )
+    w_newt, _, _ = re_mod._solve_block(
+        block, offs, w0, obj, OptimizerSpec(optimizer=OptimizerType.NEWTON), cfg
+    )
+    # Auto and explicit NEWTON produce bitwise-identical programs.
+    np.testing.assert_array_equal(np.asarray(w_auto), np.asarray(w_newt))
+
+    # And the optimum agrees with the margin-LBFGS fallback path.
+    def solve_margin(feat, lab, wt, off, w_init):
+        return minimize_lbfgs_margin(
+            obj, LabeledBatch(lab, feat, off, wt), w_init, cfg
+        ).w
+
+    w_lbfgs = jax.vmap(solve_margin)(
+        block.features, block.label, block.weight, offs, w0
+    )
+    np.testing.assert_allclose(
+        np.asarray(w_auto), np.asarray(w_lbfgs), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_newton_routing_predicate():
+    """newton_eligible covers every gate: default-spec width cutoff, explicit
+    NEWTON override, and the L1 / mask / shift-normalization exclusions."""
+    import dataclasses as dc
+
+    from photon_tpu.algorithm.random_effect import (
+        NEWTON_AUTO_MAX_DIM,
+        newton_eligible,
+    )
+    from photon_tpu.optim.factory import OptimizerSpec
+    from photon_tpu.types import OptimizerType
+
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+    default, newton = OptimizerSpec(), OptimizerSpec(optimizer=OptimizerType.NEWTON)
+    assert newton_eligible(obj, default, NEWTON_AUTO_MAX_DIM, has_mask=False)
+    # Wide-d: auto falls back, explicit NEWTON still wins.
+    assert not newton_eligible(obj, default, NEWTON_AUTO_MAX_DIM + 1, has_mask=False)
+    assert newton_eligible(obj, newton, NEWTON_AUTO_MAX_DIM + 1, has_mask=False)
+    # Exclusions: L1, Pearson mask, shift normalization, explicit TRON.
+    assert not newton_eligible(dc.replace(obj, l1_weight=0.1), default, 4, has_mask=False)
+    assert not newton_eligible(obj, default, 4, has_mask=True)
+    shifted = dc.replace(
+        obj,
+        normalization=NormalizationContext(
+            factors=jnp.ones(4), shifts=jnp.ones(4), intercept_index=None
+        ),
+    )
+    assert not newton_eligible(shifted, default, 4, has_mask=False)
+    assert not newton_eligible(
+        obj, OptimizerSpec(optimizer=OptimizerType.TRON), 4, has_mask=False
+    )
+
+
+def test_newton_dead_column_no_l2():
+    """l2=0 with a feature column no sample activates: the damping floor must
+    keep Cholesky PD so the live subspace still converges (code-review r3)."""
+    rng = np.random.default_rng(9)
+    n, d = 64, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[:, 2] = 0.0  # dead column: H[2,2] = 0, g[2] = 0
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    batch = LabeledBatch(jnp.asarray(y), jnp.asarray(X))
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.0)
+    cfg = OptimizerConfig(max_iter=30, tol=1e-7, track_history=False)
+    res = minimize_newton(obj, batch, jnp.zeros(d, jnp.float32), cfg)
+    ref = minimize_lbfgs(
+        lambda w: obj.value_and_grad(w, batch), jnp.zeros(d, jnp.float32), cfg
+    )
+    w = np.asarray(res.w)
+    assert np.isfinite(w).all()
+    assert w[2] == 0.0  # dead direction untouched
+    np.testing.assert_allclose(w, np.asarray(ref.w), rtol=2e-3, atol=2e-3)
