@@ -26,10 +26,11 @@ from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.game import FixedEffectModel
 from photon_tpu.models.glm import GeneralizedLinearModel
 from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.ops.variance import coefficient_variances, normalize_variance_type
 from photon_tpu.optim.common import OptimizeResult
 from photon_tpu.optim.factory import OptimizerSpec, make_optimizer
 from photon_tpu.sampling.down_sampler import DownSampler
-from photon_tpu.types import TaskType
+from photon_tpu.types import TaskType, VarianceComputationType
 
 Array = jax.Array
 
@@ -42,8 +43,13 @@ class FixedEffectCoordinate(Coordinate):
     objective: GLMObjective
     optimizer_spec: OptimizerSpec = dataclasses.field(default_factory=OptimizerSpec)
     down_sampler: Optional[DownSampler] = None
-    compute_variance: bool = False
+    # SIMPLE (diag-inverse) or FULL (Cholesky inverse diagonal); bool accepted
+    # for compatibility (True → SIMPLE).
+    compute_variance: object = VarianceComputationType.NONE
     dim: Optional[int] = None  # inferred from the batch if None
+
+    def __post_init__(self):
+        self.compute_variance = normalize_variance_type(self.compute_variance)
 
     def train(
         self,
@@ -64,12 +70,11 @@ class FixedEffectCoordinate(Coordinate):
         )
         solve = make_optimizer(self.objective, self.optimizer_spec)
         result = solve(w0, lb)
-        variances = None
-        if self.compute_variance:
-            # Variance via inverse diagonal Hessian
-            # (DistributedOptimizationProblem.scala:83-103 SIMPLE mode).
-            diag = self.objective.hessian_diagonal(result.w, lb)
-            variances = 1.0 / jnp.maximum(diag, 1e-12)
+        # SIMPLE/FULL variance computation
+        # (DistributedOptimizationProblem.scala:83-103 role).
+        variances = coefficient_variances(
+            self.objective, result.w, lb, self.compute_variance
+        )
         model = FixedEffectModel(
             GeneralizedLinearModel(Coefficients(result.w, variances), self.task),
             self.feature_shard,

@@ -35,6 +35,7 @@ from photon_tpu.models.game import (
     RandomEffectModel,
 )
 from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.ops.variance import coefficient_variances, normalize_variance_type
 from photon_tpu.optim.common import (
     OptimizerConfig,
     REASON_FUNCTION_VALUES_CONVERGED,
@@ -47,7 +48,7 @@ from photon_tpu.optim.newton import minimize_newton
 from photon_tpu.optim.tron import minimize_tron
 from photon_tpu.optim.owlqn import minimize_owlqn
 from photon_tpu.optim.factory import OptimizerSpec
-from photon_tpu.types import OptimizerType, TaskType
+from photon_tpu.types import OptimizerType, TaskType, VarianceComputationType
 
 Array = jax.Array
 
@@ -195,9 +196,12 @@ class RandomEffectCoordinate(Coordinate):
     task: TaskType
     objective: GLMObjective
     optimizer_spec: OptimizerSpec = dataclasses.field(default_factory=OptimizerSpec)
-    compute_variance: bool = False
+    # SIMPLE (diag-inverse) or FULL (Cholesky inverse diagonal, vmapped over
+    # entities); bool accepted for compatibility (True → SIMPLE).
+    compute_variance: object = VarianceComputationType.NONE
 
     def __post_init__(self):
+        self.compute_variance = normalize_variance_type(self.compute_variance)
         # Per-entity solves keep only aggregate tracker stats (HBM budget).
         self._config = dataclasses.replace(
             self.optimizer_spec.config(), track_history=False
@@ -290,7 +294,7 @@ class RandomEffectCoordinate(Coordinate):
             reason_list.append(reasons)
 
         variances = None
-        if self.compute_variance:
+        if self.compute_variance != VarianceComputationType.NONE:
             variances = self._block_variances(coefs, total_offset, dtype)
 
         model = RandomEffectModel(
@@ -321,11 +325,10 @@ class RandomEffectCoordinate(Coordinate):
             col_maps.append(block.col_map)
             iter_list.append(iters)
             reason_list.append(reasons)
-            if self.compute_variance:
+            if self.compute_variance != VarianceComputationType.NONE:
                 def var_one(feat, lab, wt, off, w, _obj=obj):
                     lb = LabeledBatch(lab, feat, off, wt)
-                    diag = _obj.hessian_diagonal(w, lb)
-                    return 1.0 / jnp.maximum(diag, 1e-12)
+                    return coefficient_variances(_obj, w, lb, self.compute_variance)
 
                 block_vars.append(
                     jax.vmap(var_one)(
@@ -342,7 +345,11 @@ class RandomEffectCoordinate(Coordinate):
             re_type=self.dataset.config.re_type,
             feature_shard=self.dataset.config.feature_shard,
             task=self.task,
-            block_variances=block_vars if self.compute_variance else None,
+            block_variances=(
+                block_vars
+                if self.compute_variance != VarianceComputationType.NONE
+                else None
+            ),
         )
         return model, self._tracker_stats(iter_list, reason_list)
 
@@ -360,15 +367,14 @@ class RandomEffectCoordinate(Coordinate):
         return block.project_forward(initial_model.coefficients[block.entity_idx])
 
     def _block_variances(self, coefs: Array, total_offset: Array, dtype) -> Array:
-        """Per-entity coefficient variances via inverse diagonal Hessian
+        """Per-entity coefficient variances, SIMPLE or FULL, vmapped per block
         (reference RandomEffectOptimizationProblem variance computation)."""
         E, d = self.dataset.num_entities, self.dataset.dim
         variances = jnp.ones((E, d), dtype)
 
         def var_one(feat, lab, wt, off, w):
             lb = LabeledBatch(lab, feat, off, wt)
-            diag = self.objective.hessian_diagonal(w, lb)
-            return 1.0 / jnp.maximum(diag, 1e-12)
+            return coefficient_variances(self.objective, w, lb, self.compute_variance)
 
         for block in self.dataset.blocks:
             offs = block.gather_offsets(total_offset)

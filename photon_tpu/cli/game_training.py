@@ -84,7 +84,16 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["DUMMY", "ATLAS"],
         help="tuner implementation (reference HyperparameterTunerFactory)",
     )
-    p.add_argument("--variance-computation", action="store_true")
+    p.add_argument(
+        "--variance-computation",
+        nargs="?",
+        const="SIMPLE",
+        default="NONE",
+        choices=["NONE", "SIMPLE", "FULL"],
+        help="coefficient variances: SIMPLE = inverse diagonal Hessian, "
+             "FULL = diagonal of Cholesky-inverted Hessian (reference "
+             "DistributedOptimizationProblem.scala:83-103); bare flag = SIMPLE",
+    )
     p.add_argument("--checkpoint-dir", default=None,
                    help="mid-training checkpoint/resume directory (resumes "
                         "automatically when state exists)")

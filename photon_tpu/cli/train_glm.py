@@ -78,7 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--intercept", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--coefficient-box", default=None,
                    help="lower,upper box constraint applied to all coefficients")
-    p.add_argument("--compute-variance", action="store_true")
+    p.add_argument(
+        "--compute-variance",
+        nargs="?",
+        const="SIMPLE",
+        default="NONE",
+        choices=["NONE", "SIMPLE", "FULL"],
+        help="coefficient variances (bare flag = SIMPLE diag-inverse; FULL = "
+             "Cholesky inverse diagonal)",
+    )
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="dotted paths of event listener callables")
     add_validation_arg(p)
@@ -160,10 +168,14 @@ def run(args) -> Dict:
         result = solve(w, train)
         w = result.w  # warm start (ModelTraining.scala:162-200)
         w_model = norm.transformed_to_model_space(w) if norm is not None else w
-        variances = None
-        if args.compute_variance:
-            diag = objective.hessian_diagonal(w, train)
-            variances = 1.0 / jnp.maximum(diag, 1e-12)
+        from photon_tpu.ops.variance import (
+            coefficient_variances,
+            normalize_variance_type,
+        )
+
+        variances = coefficient_variances(
+            objective, w, train, normalize_variance_type(args.compute_variance)
+        )
         models.append(
             {
                 "lambda": lam,

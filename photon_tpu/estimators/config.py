@@ -14,7 +14,7 @@ import dataclasses
 from typing import Dict, List, Optional, Sequence
 
 from photon_tpu.optim.factory import OptimizerSpec
-from photon_tpu.types import OptimizerType
+from photon_tpu.types import OptimizerType, VarianceComputationType
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,7 +47,8 @@ class FixedEffectCoordinateConfig:
     reg_weights: Sequence[float] = (0.0,)
     reg_alpha: float = 0.0
     down_sampling_rate: Optional[float] = None
-    compute_variance: bool = False
+    # VarianceComputationType (or bool/str shorthand; True → SIMPLE)
+    compute_variance: object = VarianceComputationType.NONE
 
     def optimizer_spec(self) -> OptimizerSpec:
         return OptimizerSpec(self.optimizer, self.max_iter, self.tol)
@@ -66,7 +67,8 @@ class RandomEffectCoordinateConfig:
     active_upper_bound: Optional[int] = None
     active_lower_bound: Optional[int] = None
     features_to_samples_ratio: Optional[float] = None
-    compute_variance: bool = False
+    # VarianceComputationType (or bool/str shorthand; True → SIMPLE)
+    compute_variance: object = VarianceComputationType.NONE
 
     def optimizer_spec(self) -> OptimizerSpec:
         return OptimizerSpec(self.optimizer, self.max_iter, self.tol)

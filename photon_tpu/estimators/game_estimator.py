@@ -41,8 +41,9 @@ from photon_tpu.evaluation.suite import EvaluationSuite
 from photon_tpu.models.game import GameModel
 from photon_tpu.ops.losses import loss_for_task
 from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.ops.variance import normalize_variance_type
 from photon_tpu.sampling.down_sampler import down_sampler_for_task
-from photon_tpu.types import TaskType
+from photon_tpu.types import TaskType, VarianceComputationType
 from photon_tpu.utils.timed import Timed
 
 logger = logging.getLogger(__name__)
@@ -83,7 +84,7 @@ class GameEstimator:
         normalization: Optional[Dict[str, NormalizationContext]] = None,
         num_entities: Optional[Dict[str, int]] = None,
         locked_coordinates: Sequence[str] = (),
-        variance_computation: bool = False,
+        variance_computation: object = None,  # VarianceComputationType/bool/str
     ):
         self.task = task
         self.coordinate_configs = list(coordinate_configs)
@@ -92,8 +93,14 @@ class GameEstimator:
         self.normalization = normalization or {}
         self.num_entities = num_entities or {}
         self.locked_coordinates = list(locked_coordinates)
-        self.variance_computation = variance_computation
+        self.variance_computation = normalize_variance_type(variance_computation)
         self.update_sequence = [c.coordinate_id for c in self.coordinate_configs]
+
+    def _variance_type(self, cfg):
+        """Per-coordinate setting wins; estimator-level is the fallback
+        (reference variance flag precedence)."""
+        per = normalize_variance_type(cfg.compute_variance)
+        return per if per != VarianceComputationType.NONE else self.variance_computation
 
     # --- prepareTrainingDatasets role ---
 
@@ -124,7 +131,7 @@ class GameEstimator:
                     objective=objective,
                     optimizer_spec=cfg.optimizer_spec(),
                     down_sampler=sampler,
-                    compute_variance=cfg.compute_variance or self.variance_computation,
+                    compute_variance=self._variance_type(cfg),
                     dim=batch.features[cfg.feature_shard].shape[1],
                 )
             elif isinstance(cfg, RandomEffectCoordinateConfig):
@@ -141,7 +148,7 @@ class GameEstimator:
                     task=self.task,
                     objective=objective,
                     optimizer_spec=cfg.optimizer_spec(),
-                    compute_variance=cfg.compute_variance or self.variance_computation,
+                    compute_variance=self._variance_type(cfg),
                 )
             else:
                 raise TypeError(f"unknown coordinate config {type(cfg)}")

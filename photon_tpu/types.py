@@ -48,6 +48,16 @@ class NormalizationType(enum.Enum):
     STANDARDIZATION = "STANDARDIZATION"
 
 
+class VarianceComputationType(enum.Enum):
+    """Coefficient-variance computation mode (reference
+    DistributedOptimizationProblem.scala:83-103: SIMPLE = inverse diagonal
+    Hessian, FULL = diagonal of the full inverse Hessian via Cholesky)."""
+
+    NONE = "NONE"
+    SIMPLE = "SIMPLE"
+    FULL = "FULL"
+
+
 class OptimizerType(enum.Enum):
     """Optimizer selection (reference OptimizerType / OptimizerFactory)."""
 
