@@ -62,6 +62,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--locked-coordinates", default="",
                    help="comma-separated coordinate ids to keep fixed (partial retrain)")
     p.add_argument(
+        "--coordinate-constraints",
+        default=None,
+        help='JSON object: coordinate id → constraint array, e.g. '
+             '{"global": [{"name": "f1", "term": "", "lowerBound": 0}]}. '
+             "GLMSuite bound semantics, resolved against the coordinate's "
+             "feature-shard index map; fixed-effect coordinates only",
+    )
+    p.add_argument(
         "--output-mode",
         default="BEST",
         choices=["BEST", "ALL", "NONE", "EXPLICIT", "TUNED"],
@@ -161,6 +169,38 @@ def run(args) -> Dict:
                 norm_type, stats.mean, stats.std, stats.abs_max,
                 intercept_indices.get(shard),
             )
+
+    # Per-feature constraint maps → per-coordinate bound vectors
+    # (GLMSuite.scala:49-126 semantics, GAME-side extension).
+    if args.coordinate_constraints:
+        import dataclasses as _dc
+
+        from photon_tpu.data.constraints import constraint_bound_vectors
+        from photon_tpu.estimators.config import FixedEffectCoordinateConfig
+
+        cmap = json.loads(args.coordinate_constraints)
+        unknown = set(cmap) - {c.coordinate_id for c in coord_configs}
+        if unknown:
+            raise ValueError(f"constraints for unknown coordinates: {sorted(unknown)}")
+        for i, c in enumerate(coord_configs):
+            entries = cmap.get(c.coordinate_id)
+            if entries is None:
+                continue
+            if not isinstance(c, FixedEffectCoordinateConfig):
+                raise ValueError(
+                    f"coordinate constraints apply to fixed-effect coordinates "
+                    f"only; '{c.coordinate_id}' is a random-effect coordinate"
+                )
+            bounds = constraint_bound_vectors(
+                json.dumps(entries),
+                index_maps[c.feature_shard],
+                batch.features[c.feature_shard].shape[1],
+                intercept_indices.get(c.feature_shard),
+            )
+            if bounds is not None:
+                coord_configs[i] = _dc.replace(
+                    c, box=(jnp.asarray(bounds[0]), jnp.asarray(bounds[1]))
+                )
 
     warm = None
     if args.model_input_dir:

@@ -79,6 +79,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coefficient-box", default=None,
                    help="lower,upper box constraint applied to all coefficients")
     p.add_argument(
+        "--constraint-string",
+        default=None,
+        help="JSON array of per-feature bounds "
+             '[{"name": ..., "term": ..., "lowerBound": ..., "upperBound": ...}] '
+             "with GLMSuite wildcard semantics (reference "
+             "io/deprecated/GLMSuite.scala:190-260)",
+    )
+    p.add_argument(
         "--compute-variance",
         nargs="?",
         const="SIMPLE",
@@ -145,6 +153,18 @@ def run(args) -> Dict:
         lo, hi = (float(x) for x in args.coefficient_box.split(","))
         d = train.dim
         box = (jnp.full((d,), lo, jnp.float32), jnp.full((d,), hi, jnp.float32))
+    if args.constraint_string:
+        from photon_tpu.data.constraints import constraint_bound_vectors
+
+        if box is not None:
+            raise ValueError(
+                "--constraint-string and --coefficient-box are exclusive"
+            )
+        bounds = constraint_bound_vectors(
+            args.constraint_string, imap, train.dim, icpt
+        )
+        if bounds is not None:
+            box = (jnp.asarray(bounds[0]), jnp.asarray(bounds[1]))
 
     weights = sorted(float(x) for x in args.regularization_weights.split(","))
     weights.reverse()  # strongest first: warm start toward weaker reg

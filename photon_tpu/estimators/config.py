@@ -49,9 +49,14 @@ class FixedEffectCoordinateConfig:
     down_sampling_rate: Optional[float] = None
     # VarianceComputationType (or bool/str shorthand; True → SIMPLE)
     compute_variance: object = VarianceComputationType.NONE
+    # Per-coordinate (lower, upper) bound vectors (data/constraints.py), fed
+    # to the box-constrained solvers. GAME-side extension of the legacy
+    # constraint map (GLMSuite.scala:49-126) — absent in the reference's
+    # GAME path.
+    box: Optional[tuple] = None
 
     def optimizer_spec(self) -> OptimizerSpec:
-        return OptimizerSpec(self.optimizer, self.max_iter, self.tol)
+        return OptimizerSpec(self.optimizer, self.max_iter, self.tol, box=self.box)
 
 
 @dataclasses.dataclass

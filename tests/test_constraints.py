@@ -1,0 +1,167 @@
+"""Per-feature constraint maps (reference GLMSuite.scala:49-126, 190-260)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.constraints import constraint_bound_vectors
+from photon_tpu.data.index_map import IndexMap
+
+
+def _imap():
+    return IndexMap.build(
+        [IndexMap.key("age", ""), IndexMap.key("geo", "us"), IndexMap.key("geo", "uk")],
+        add_intercept=True,
+    )
+
+
+def test_explicit_name_term_bounds():
+    imap = _imap()
+    s = json.dumps([
+        {"name": "age", "term": "", "lowerBound": -1.0, "upperBound": 1.0},
+        {"name": "geo", "term": "uk", "lowerBound": 0.0},
+    ])
+    lower, upper = constraint_bound_vectors(s, imap, len(imap))
+    i_age = imap.get_index(IndexMap.key("age", ""))
+    i_uk = imap.get_index(IndexMap.key("geo", "uk"))
+    i_us = imap.get_index(IndexMap.key("geo", "us"))
+    assert (lower[i_age], upper[i_age]) == (-1.0, 1.0)
+    assert lower[i_uk] == 0.0 and np.isinf(upper[i_uk])
+    assert np.isinf(lower[i_us]) and np.isinf(upper[i_us])
+
+
+def test_term_wildcard_expands_over_bag():
+    imap = _imap()
+    s = json.dumps([{"name": "geo", "term": "*", "upperBound": 2.0}])
+    lower, upper = constraint_bound_vectors(s, imap, len(imap))
+    for term in ("us", "uk"):
+        i = imap.get_index(IndexMap.key("geo", term))
+        assert upper[i] == 2.0
+    i_age = imap.get_index(IndexMap.key("age", ""))
+    assert np.isinf(upper[i_age])
+
+
+def test_all_wildcard_excludes_intercept():
+    imap = _imap()
+    icpt = imap.get_index(IndexMap.INTERCEPT)
+    s = json.dumps([{"name": "*", "term": "*", "lowerBound": -3.0, "upperBound": 3.0}])
+    lower, upper = constraint_bound_vectors(s, imap, len(imap), icpt)
+    assert np.isinf(lower[icpt]) and np.isinf(upper[icpt])
+    i_age = imap.get_index(IndexMap.key("age", ""))
+    assert (lower[i_age], upper[i_age]) == (-3.0, 3.0)
+
+
+@pytest.mark.parametrize(
+    "entries,match",
+    [
+        ([{"name": "age"}], "name.*term|term"),  # missing term key
+        ([{"name": "age", "term": ""}], "empty constraint|infinite"),
+        ([{"name": "age", "term": "", "lowerBound": 2.0, "upperBound": 1.0}], "lower bound"),
+        ([{"name": "*", "term": "x", "lowerBound": 0.0}], "wildcard"),
+        (
+            [
+                {"name": "geo", "term": "uk", "lowerBound": 0.0},
+                {"name": "geo", "term": "*", "upperBound": 1.0},
+            ],
+            "conflicting",
+        ),
+        (
+            [
+                {"name": "age", "term": "", "lowerBound": 0.0},
+                {"name": "*", "term": "*", "upperBound": 1.0},
+            ],
+            "wildcard constraint cannot be combined",
+        ),
+    ],
+)
+def test_malformed_constraints_raise(entries, match):
+    with pytest.raises(ValueError, match=match):
+        constraint_bound_vectors(json.dumps(entries), _imap(), len(_imap()))
+
+
+def test_absent_features_ignored():
+    s = json.dumps([{"name": "nope", "term": "x", "lowerBound": 0.0}])
+    assert constraint_bound_vectors(s, _imap(), len(_imap())) is None
+
+
+def test_game_driver_constraints_bind(tmp_path):
+    """Two named features constrained to tight boxes must come out ON their
+    bounds (their unconstrained optima lie outside)."""
+    from photon_tpu.cli import game_training
+    from tests.test_drivers import write_fixture
+
+    train = tmp_path / "train.avro"
+    write_fixture(str(train), n=500, d=4)
+    out = tmp_path / "out"
+    constraints = {
+        "global": [
+            {"name": "x0", "term": "", "lowerBound": -0.02, "upperBound": 0.02},
+            {"name": "x3", "term": "", "lowerBound": -0.02, "upperBound": 0.02},
+        ]
+    }
+    args = game_training.build_parser().parse_args(
+        [
+            "--input-paths", str(train),
+            "--output-dir", str(out),
+            "--feature-shard-configurations", "name=s",
+            "--coordinate-configurations",
+            "name=global,feature.shard=s,reg.weights=0.01",
+            "--update-sequence", "global",
+            "--evaluators",
+            "--coordinate-constraints", json.dumps(constraints),
+        ]
+    )
+    game_training.run(args)
+    model_path = (
+        out / "best" / "fixed-effect" / "global" / "coefficients" / "part-00000.avro"
+    )
+    from photon_tpu.io.avro import read_avro_records
+
+    (record,) = read_avro_records(str(model_path))
+    by_name = {m["name"]: m["value"] for m in record["means"]}
+    # write_fixture uses w = linspace(-1, 1, d): x0 ≈ -1, x3 ≈ +1
+    # unconstrained — both must bind at the box edge.
+    assert by_name["x0"] == pytest.approx(-0.02, abs=1e-3)
+    assert by_name["x3"] == pytest.approx(0.02, abs=1e-3)
+    # Unconstrained features stay free.
+    assert abs(by_name["x1"]) > 0.05 or abs(by_name["x2"]) > 0.05
+
+
+def test_legacy_driver_constraint_string(tmp_path):
+    from photon_tpu.cli import train_glm
+
+    rng = np.random.default_rng(3)
+    lines = []
+    for _ in range(300):
+        x = rng.normal(size=3)
+        logit = 2.0 * x[0] - 2.0 * x[1]
+        y = 1 if rng.uniform() < 1 / (1 + np.exp(-logit)) else -1
+        lines.append(
+            f"{y:+d} 1:{x[0]:.4f} 2:{x[1]:.4f} 3:{x[2]:.4f}"
+        )
+    libsvm = tmp_path / "t.txt"
+    libsvm.write_text("\n".join(lines))
+    out = tmp_path / "o"
+    s = json.dumps([{"name": "1", "term": "", "lowerBound": -0.1, "upperBound": 0.1}])
+    args = train_glm.build_parser().parse_args(
+        [
+            "--training-data", str(libsvm), "--format", "libsvm",
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "0.01",
+            "--constraint-string", s,
+        ]
+    )
+    train_glm.run(args)
+    # Text model output (IOUtils.writeModelsInText role): key<TAB>value.
+    text = (out / "model-lambda-0.01.txt").read_text()
+    coefs = {
+        line.split("\t")[0]: float(line.split("\t")[1])
+        for line in text.splitlines()
+        if "\t" in line
+    }
+    # Feature "1" (strong positive signal) binds at its 0.1 upper bound;
+    # feature "2" (strong negative) stays free well below -0.1.
+    assert coefs["1"] == pytest.approx(0.1, abs=5e-3)
+    assert coefs["2"] < -0.5
