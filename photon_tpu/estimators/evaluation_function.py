@@ -144,6 +144,32 @@ class GameEstimatorEvaluationFunction:
         self.results.append(result)
         return self.direction * self._primary_metric(result)
 
+    def evaluate_batch(self, X: np.ndarray) -> List[float]:
+        """Evaluate q candidate vectors together. Uses the vmapped
+        one-program fast path (estimators/batched_tuning.py) when the setup
+        is batchable; otherwise falls back to q sequential fits. Returns
+        signed values in the tuner's minimization convention, matching
+        ``__call__``."""
+        X = np.asarray(X, float)
+        fast = self._batched_evaluator()
+        if fast is not None:
+            return [self.direction * m for m in fast(X)]
+        return [self(x) for x in X]
+
+    def _batched_evaluator(self):
+        if not hasattr(self, "_batched"):
+            from photon_tpu.estimators.batched_tuning import build_batched_evaluator
+
+            self._batched = build_batched_evaluator(
+                self.estimator,
+                self.base_config,
+                self._slots,
+                self.batch,
+                self.validation_batch,
+                self.evaluation_suite,
+            )
+        return self._batched
+
     def _primary_metric(self, result) -> float:
         if result.metrics is None:
             raise ValueError(

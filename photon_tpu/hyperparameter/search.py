@@ -71,6 +71,10 @@ class RandomSearch:
     def next_point(self) -> np.ndarray:
         return self.draw_candidates(1)[0]
 
+    def next_batch(self, q: int) -> np.ndarray:
+        """(q, dim) proposals for one concurrent-evaluation round."""
+        return self.draw_candidates(q)
+
     # --- driver loops (findWithPriors / find roles) ---
 
     def observe(self, x: np.ndarray, value: float) -> None:
@@ -86,9 +90,12 @@ class RandomSearch:
 
     def find_batch(self, n_rounds: int, q: int,
                    batch_evaluator: Callable[[np.ndarray], Sequence[float]]) -> Tuple[np.ndarray, float]:
-        """q proposals per round evaluated together (mesh-parallel tuning)."""
+        """q proposals per round evaluated together (mesh-parallel tuning).
+        Proposals come from ``next_batch`` — Sobol here, top-q EI in the
+        Bayesian subclass — so each round refines on the last round's
+        observations."""
         for _ in range(n_rounds):
-            X = self.draw_candidates(q)
+            X = self.next_batch(q)
             for x, v in zip(X, batch_evaluator(X)):
                 self.observe(x, float(v))
         return min(self.observations, key=lambda o: o[1])
@@ -112,10 +119,24 @@ class GaussianProcessSearch(RandomSearch):
     def next_point(self) -> np.ndarray:
         if len(self.observations) < self.min_observations:
             return super().next_point()
+        cand_unit, ei = self._ei_over_candidates()
+        return self.range.rescale(cand_unit[int(np.argmax(ei))])
+
+    def next_batch(self, q: int) -> np.ndarray:
+        """Top-q EI candidates in one round (batch Bayesian proposals —
+        the q Sobol candidates with the best acquisition, all from the same
+        posterior; cheaper than q sequential constant-liar refits and
+        adequate for the mesh-parallel training win)."""
+        if len(self.observations) < self.min_observations:
+            return super().next_batch(q)
+        cand_unit, ei = self._ei_over_candidates()
+        top = np.argsort(-ei)[:q]
+        return self.range.rescale(cand_unit[top])
+
+    def _ei_over_candidates(self) -> Tuple[np.ndarray, np.ndarray]:
         X = np.stack([o[0] for o in self.observations])
         y = np.array([o[1] for o in self.observations])
         model = self.estimator.fit(self.range.to_unit(X), y)
         cand_unit = self._sobol.random(self.num_candidates)
         mean, std = model.predict(cand_unit)
-        ei = expected_improvement(mean, std, float(np.min(y)))
-        return self.range.rescale(cand_unit[int(np.argmax(ei))])
+        return cand_unit, expected_improvement(mean, std, float(np.min(y)))

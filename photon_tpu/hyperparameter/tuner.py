@@ -45,6 +45,7 @@ class HyperparameterTuner:
         search_range: Optional[SearchRange] = None,
         prior_observations: Optional[List[Tuple[np.ndarray, float]]] = None,
         seed: int = 1,
+        batch_size: int = 1,
     ) -> Tuple[Optional[np.ndarray], Optional[float], List[Tuple[np.ndarray, float]]]:
         raise NotImplementedError
 
@@ -53,18 +54,28 @@ class DummyTuner(HyperparameterTuner):
     """No-op (reference DummyTuner)."""
 
     def search(self, n, dim, mode, evaluator, search_range=None,
-               prior_observations=None, seed=1):
+               prior_observations=None, seed=1, batch_size=1):
         return None, None, list(prior_observations or [])
 
 
 class AtlasTuner(HyperparameterTuner):
     def search(self, n, dim, mode, evaluator, search_range=None,
-               prior_observations=None, seed=1):
+               prior_observations=None, seed=1, batch_size=1):
+        """``batch_size > 1`` proposes that many candidates per round and
+        evaluates them together through ``evaluator.evaluate_batch`` (the
+        vmapped mesh-parallel path — improvement over the reference's
+        one-candidate-per-round loop, GameEstimator.scala:364-382)."""
         cls = GaussianProcessSearch if mode == TuningMode.BAYESIAN else RandomSearch
         search = cls(dim, evaluator, search_range, seed=seed)
         for x, v in prior_observations or []:
             search.observe(x, v)
-        best_x, best_v = search.find(n)
+        if batch_size > 1 and hasattr(evaluator, "evaluate_batch"):
+            rounds = -(-n // batch_size)  # ceil: at least n evaluations
+            best_x, best_v = search.find_batch(
+                rounds, batch_size, evaluator.evaluate_batch
+            )
+        else:
+            best_x, best_v = search.find(n)
         return best_x, best_v, search.observations
 
 

@@ -76,7 +76,13 @@ def glmix_train_step(
         re_block: EntityBlock,
         re_features_flat: Array,  # (n, d_re) per-sample RE shard features
         re_entity_ids: Array,  # (n,)
+        fe_l2: Array = None,  # traced λ overriding the FE objective's L2
+        re_l2: Array = None,  # traced λ overriding the RE objective's L2
     ):
+        # The l2 overrides are the hyperparameter-sweep hook: vmapping this
+        # step over (fe_l2, re_l2) lanes trains a whole λ grid in ONE program
+        # sharing each X pass (SURVEY.md §2.7.5 — parallel tuning, absent in
+        # the reference's sequential loop GameEstimator.scala:364-382).
         # --- RE scores on the flat batch (gather by entity) ---
         def re_scores_of(coefs):
             valid = re_entity_ids >= 0
@@ -90,6 +96,7 @@ def glmix_train_step(
             fe_batch.add_scores_to_offsets(re_scores_of(re_coefs)),
             w_fixed,
             fe_config,
+            l2_override=fe_l2,
         )
         w_fixed_new = fe_res.w
 
@@ -100,9 +107,13 @@ def glmix_train_step(
         def solve_one(feat, lab, wt, off, w_init):
             lb = LabeledBatch(lab, feat, off, wt)
             if re_solver == "newton":
-                res = minimize_newton(re_objective, lb, w_init, re_config)
+                res = minimize_newton(
+                    re_objective, lb, w_init, re_config, l2_override=re_l2
+                )
             else:
-                res = minimize_lbfgs_margin(re_objective, lb, w_init, re_config)
+                res = minimize_lbfgs_margin(
+                    re_objective, lb, w_init, re_config, l2_override=re_l2
+                )
             return res.w, res.evals
 
         w_init = re_coefs[re_block.entity_idx]
