@@ -39,6 +39,27 @@ class CoordinateDescentResult:
     best_metric: Optional[float]
     metric_history: List[Dict[str, float]]
     tracker: Dict[str, list]
+    # Host-measured wall seconds per (coordinate, CD pass) solve — the
+    # driver-level timing the reference's OptimizationStatesTracker records
+    # per optimizer iteration (OptimizationStatesTracker.scala:61-113). Here
+    # a whole solve is ONE compiled program, so the solve is the smallest
+    # host-observable unit; per-iteration loss/|grad| live in the jit-side
+    # history rings instead.
+    wall_times: Dict[str, List[float]] = dataclasses.field(default_factory=dict)
+
+    def summary(self) -> str:
+        """Per-coordinate optimization summary table (toSummaryString role):
+        the jit-recorded per-iteration loss/|grad| histories joined with the
+        host-side wall time of each solve."""
+        lines: List[str] = []
+        for cid, diags in self.tracker.items():
+            walls = self.wall_times.get(cid, [])
+            for p, diag in enumerate(diags):
+                wall = f"{walls[p]:.3f}s" if p < len(walls) else "n/a"
+                lines.append(f"-- coordinate {cid!r}, CD pass {p} (wall {wall})")
+                body = diag.summary() if hasattr(diag, "summary") else repr(diag)
+                lines.extend("   " + ln for ln in body.splitlines())
+        return "\n".join(lines)
 
 
 class CoordinateDescent:
@@ -84,6 +105,7 @@ class CoordinateDescent:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
         checkpoint_tag: Optional[str] = None,
+        emitter=None,  # utils.events.EventEmitter; optimization-log events
     ) -> CoordinateDescentResult:
         """Descend; with validation data, tracks the best model seen across
         iterations by the primary metric (descendWithValidation role).
@@ -122,6 +144,7 @@ class CoordinateDescent:
             total_scores = total_scores + s
 
         tracker: Dict[str, list] = {cid: [] for cid in self.update_sequence}
+        wall_times: Dict[str, List[float]] = {cid: [] for cid in self.update_sequence}
         metric_history: List[Dict[str, float]] = []
         best_metric: Optional[float] = None
         best_model = GameModel(dict(models)) if all(
@@ -152,6 +175,9 @@ class CoordinateDescent:
                 best_metric = state["best_metric"]
                 best_model = state["best_model"]
                 tracker = state["tracker"]
+                wall_times = state.get(
+                    "wall_times", {cid: [] for cid in self.update_sequence}
+                )
                 start_it = step + 1
                 logger.info(
                     "resuming coordinate descent from checkpoint step %d", step
@@ -170,14 +196,31 @@ class CoordinateDescent:
                 residual = None if single else total_scores - scores[cid]
                 model, diag = coord.train(batch, residual, models[cid])
                 new_scores = coord.score(model, batch)
+                # The clock must cover device execution, not just dispatch.
+                jax.block_until_ready(new_scores)
+                wall = time.monotonic() - t0
                 total_scores = total_scores - scores[cid] + new_scores
                 scores[cid] = new_scores
                 models[cid] = model
                 tracker[cid].append(diag)
+                wall_times[cid].append(wall)
                 logger.info(
-                    "CD iter %d coordinate %s trained in %.2fs",
-                    it, cid, time.monotonic() - t0,
+                    "CD iter %d coordinate %s trained in %.2fs", it, cid, wall
                 )
+                if emitter is not None:
+                    from photon_tpu.utils.events import optimization_log_event
+
+                    emitter.emit(
+                        optimization_log_event(
+                            coordinate=cid,
+                            cd_iteration=it,
+                            wall_s=wall,
+                            summary=(
+                                diag.summary() if hasattr(diag, "summary")
+                                else repr(diag)
+                            ),
+                        )
+                    )
 
             if validation_fn is not None and validation_batch is not None:
                 game_model = GameModel(dict(models))
@@ -202,6 +245,7 @@ class CoordinateDescent:
                         best_metric=best_metric,
                         best_model=best_model,
                         tracker=tracker,
+                        wall_times=wall_times,
                         tag=checkpoint_tag or ",".join(self.update_sequence),
                     ),
                     it,
@@ -210,10 +254,15 @@ class CoordinateDescent:
         final = GameModel(dict(models))
         if best_model is None:
             best_model = final
-        return CoordinateDescentResult(
+        result = CoordinateDescentResult(
             model=final,
             best_model=best_model,
             best_metric=best_metric,
             metric_history=metric_history,
             tracker=tracker,
+            wall_times=wall_times,
         )
+        summary = result.summary()
+        if summary:
+            logger.info("optimization summary:\n%s", summary)
+        return result

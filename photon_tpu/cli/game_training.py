@@ -113,6 +113,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "automatically when state exists)")
     p.add_argument("--checkpoint-every", type=int, default=1,
                    help="checkpoint cadence in CD iterations")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   help="dotted paths of event listener callables "
+                        "(Driver.scala:99-108 registration role)")
     return p
 
 
@@ -227,6 +230,20 @@ def run(args) -> Dict:
         locked_coordinates=[s for s in args.locked_coordinates.split(",") if s],
         variance_computation=args.variance_computation,
     )
+    from photon_tpu.utils.events import (
+        EventEmitter,
+        training_finish_event,
+        training_start_event,
+    )
+
+    emitter = EventEmitter()
+    for name in args.event_listeners:
+        emitter.register_by_name(name)
+    emitter.emit(
+        training_start_event(
+            task=task.value, coordinates=list(update_sequence)
+        )
+    )
     results = estimator.fit(
         batch,
         validation_batch=valid_batch,
@@ -234,6 +251,7 @@ def run(args) -> Dict:
         initial_model=warm,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        emitter=emitter,
     )
 
     # --- hyperparameter auto-tuning (runHyperparameterTuning role,
@@ -290,6 +308,9 @@ def run(args) -> Dict:
     summary["best"] = {"config": best.config.describe(), "metrics": best.metrics}
     with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
+    emitter.emit(
+        training_finish_event(best=None if best is None else best.config.describe())
+    )
     return summary
 
 

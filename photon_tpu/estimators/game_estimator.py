@@ -209,6 +209,7 @@ class GameEstimator:
         initial_model: Optional[GameModel] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
+        emitter=None,  # utils.events.EventEmitter for optimization-log events
     ) -> List[GameResult]:
         """Train one GameModel per optimization configuration, warm-starting
         each config from the previous result (fit:364-382 role).
@@ -257,6 +258,7 @@ class GameEstimator:
                     # changed grid/sequence fails loudly instead of serving a
                     # stale model from the same cfg index.
                     checkpoint_tag=f"{opt_config.describe()}|{','.join(self.update_sequence)}",
+                    emitter=emitter,
                 )
             metrics = cd_result.metric_history[-1] if cd_result.metric_history else None
             results.append(
