@@ -116,6 +116,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="dotted paths of event listener callables "
                         "(Driver.scala:99-108 registration role)")
+    p.add_argument("--summarization-output-dir", default=None,
+                   help="write per-feature summary statistics as "
+                        "FeatureSummarizationResultAvro, one file per shard "
+                        "(ModelProcessingUtils.writeBasicStatistics role)")
     return p
 
 
@@ -169,15 +173,25 @@ def run(args) -> Dict:
     }
     normalization = {}
     norm_type = NormalizationType[args.normalization]
-    if norm_type != NormalizationType.NONE:
+    if norm_type != NormalizationType.NONE or args.summarization_output_dir:
         for shard in shard_configs:
             stats = compute_feature_stats(
                 batch.labeled_batch(shard), intercept_indices.get(shard)
             )
-            normalization[shard] = build_normalization_context(
-                norm_type, stats.mean, stats.std, stats.abs_max,
-                intercept_indices.get(shard),
-            )
+            if norm_type != NormalizationType.NONE:
+                normalization[shard] = build_normalization_context(
+                    norm_type, stats.mean, stats.std, stats.abs_max,
+                    intercept_indices.get(shard),
+                )
+            if args.summarization_output_dir:
+                from photon_tpu.io.model_io import write_basic_statistics
+
+                write_basic_statistics(
+                    stats, index_maps[shard],
+                    os.path.join(
+                        args.summarization_output_dir, shard, "part-00000.avro"
+                    ),
+                )
 
     # Per-feature constraint maps → per-coordinate bound vectors
     # (GLMSuite.scala:49-126 semantics, GAME-side extension).

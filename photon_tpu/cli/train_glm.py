@@ -97,6 +97,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="dotted paths of event listener callables")
+    p.add_argument("--summarization-output-dir", default=None,
+                   help="write per-feature summary statistics as "
+                        "FeatureSummarizationResultAvro "
+                        "(writeBasicStatistics role)")
     add_validation_arg(p)
     p.add_argument("--verbose", action="store_true")
     return p
@@ -143,9 +147,19 @@ def run(args) -> Dict:
 
     norm = None
     norm_type = NormalizationType[args.normalization]
-    if norm_type != NormalizationType.NONE:
+    if norm_type != NormalizationType.NONE or args.summarization_output_dir:
         stats = compute_feature_stats(train, icpt)
-        norm = build_normalization_context(norm_type, stats.mean, stats.std, stats.abs_max, icpt)
+        if norm_type != NormalizationType.NONE:
+            norm = build_normalization_context(
+                norm_type, stats.mean, stats.std, stats.abs_max, icpt
+            )
+        if args.summarization_output_dir:
+            from photon_tpu.io.model_io import write_basic_statistics
+
+            write_basic_statistics(
+                stats, imap,
+                os.path.join(args.summarization_output_dir, "part-00000.avro"),
+            )
     stage = DriverStage.PREPROCESSED
 
     box = None

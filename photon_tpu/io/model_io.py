@@ -302,3 +302,43 @@ def load_game_model(
                 None if variances_arr is None else jnp.asarray(variances_arr),
             )
     return GameModel(models)
+
+
+def write_basic_statistics(stats, index_map: IndexMap, path: str) -> None:
+    """Per-feature summary statistics as FeatureSummarizationResultAvro
+    (reference ModelProcessingUtils.writeBasicStatistics,
+    ModelProcessingUtils.scala:516): one record per feature with a
+    metric-name → value map."""
+    from photon_tpu.io.schemas import FEATURE_SUMMARIZATION_SCHEMA
+
+    records = []
+    d = int(np.asarray(stats.mean).shape[0])
+    mean = np.asarray(stats.mean, np.float64)
+    var = np.asarray(stats.variance, np.float64)
+    mn = np.asarray(stats.min, np.float64)
+    mx = np.asarray(stats.max, np.float64)
+    l1 = np.asarray(stats.norm_l1, np.float64)
+    l2 = np.asarray(stats.norm_l2, np.float64)
+    nnz = np.asarray(stats.num_nonzeros, np.float64)
+    for j in range(d):
+        key = index_map.get_feature_name(j)
+        if key is None:
+            continue
+        name, term = _split_key(key)
+        records.append(
+            {
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {
+                    "mean": float(mean[j]),
+                    "variance": float(var[j]),
+                    "min": float(mn[j]),
+                    "max": float(mx[j]),
+                    "normL1": float(l1[j]),
+                    "normL2": float(l2[j]),
+                    "numNonzeros": float(nnz[j]),
+                },
+            }
+        )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    write_avro_records(path, FEATURE_SUMMARIZATION_SCHEMA, records)

@@ -8,12 +8,18 @@ per-coordinate models, per-coordinate score arrays, residual total, iteration
 counter, metric history — persists to host storage, so a preempted job
 resumes mid-descent instead of restarting the λ-sweep entry.
 
-Format: one ``step_<N>.npz`` with the flattened pytree leaves plus a pickled
-treedef (all photon_tpu model classes are registered pytree nodes, so the
-treedef round-trips typed objects — GameModel/FixedEffectModel/... come back
-as themselves, not dict skeletons). bfloat16 leaves are stored as uint16
-views (npz has no bf16). A ``LATEST`` file names the newest step;
-``step_<N>`` files are self-contained so older steps remain loadable.
+Format: one ``step_<N>.npz`` holding the array leaves plus a **declarative
+JSON manifest** describing the structure: containers, literals, enums by
+registry key + value, and framework objects by REGISTRY KEY + field names
+(+ per-array shape/dtype for validation). No pickled code objects anywhere —
+loading a checkpoint can only construct classes explicitly allow-listed in
+``_REGISTRY``, so an untrusted checkpoint directory cannot execute arbitrary
+code (pickle's failure mode), and renaming/moving a class doesn't strand old
+checkpoints as long as its registry key stays stable.
+
+bfloat16 leaves are stored as uint16 views (npz has no bf16). A ``LATEST``
+file names the newest step; ``step_<N>`` files are self-contained so older
+steps remain loadable.
 
 Single-host persistence (np.savez gathers sharded arrays). Multi-host
 sharded checkpointing can swap in orbax behind the same API later.
@@ -21,43 +27,203 @@ sharded checkpointing can swap in orbax behind the same API later.
 
 from __future__ import annotations
 
+import dataclasses
+import enum
+import json
 import os
-import pickle
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple, Type
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 _LATEST = "LATEST"
+_FORMAT_VERSION = 2
+
+# ---------------------------------------------------------------------------
+# Registry: stable key ↔ class. Keys are the durable identity — keep them
+# unchanged across refactors/renames.
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Type] = {}
+_KEY_OF: Dict[Type, str] = {}
 
 
-def _to_saveable(leaf):
-    arr = np.asarray(leaf)
-    if arr.dtype == jnp.bfloat16:
-        return arr.view(np.uint16), "bfloat16"
-    return arr, str(arr.dtype)
+def register_checkpoint_node(key: str, cls: Type) -> None:
+    """Allow-list ``cls`` for checkpoint (de)serialization under ``key``.
+    Dataclasses round-trip by field names; Enums by value."""
+    _REGISTRY[key] = cls
+    _KEY_OF[cls] = key
+
+
+def _register_builtin_nodes() -> None:
+    from photon_tpu.algorithm.random_effect import RandomEffectTrackerStats
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        ProjectedRandomEffectModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.optim.common import OptimizeResult, OptimizerConfig
+    from photon_tpu.types import OptimizerType, TaskType, VarianceComputationType
+
+    for key, cls in {
+        "game_model": GameModel,
+        "fixed_effect_model": FixedEffectModel,
+        "random_effect_model": RandomEffectModel,
+        "projected_random_effect_model": ProjectedRandomEffectModel,
+        "glm": GeneralizedLinearModel,
+        "coefficients": Coefficients,
+        "optimize_result": OptimizeResult,
+        "optimizer_config": OptimizerConfig,
+        "re_tracker_stats": RandomEffectTrackerStats,
+        "task_type": TaskType,
+        "optimizer_type": OptimizerType,
+        "variance_type": VarianceComputationType,
+    }.items():
+        register_checkpoint_node(key, cls)
+
+
+# ---------------------------------------------------------------------------
+# Encode / decode
+# ---------------------------------------------------------------------------
+
+
+def _is_array(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray)) or (
+        isinstance(x, np.generic) and not isinstance(x, (np.str_, np.bytes_))
+    )
+
+
+def _encode(obj: Any, arrays: list) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"t": "lit", "v": obj}
+    if _is_array(obj):
+        arr = np.asarray(obj)
+        dt = "bfloat16" if arr.dtype == jnp.bfloat16 else str(arr.dtype)
+        if dt == "bfloat16":
+            arr = arr.view(np.uint16)
+        idx = len(arrays)
+        arrays.append(arr)
+        # Scalar numpy values re-materialize as python scalars on load when
+        # they were np.generic (counters, metrics) — tagged separately.
+        kind = "scalar" if obj.__class__.__module__ == "numpy" and arr.ndim == 0 else "array"
+        return {
+            "t": kind, "i": idx, "shape": list(arr.shape), "dtype": dt,
+        }
+    if isinstance(obj, (list, tuple)):
+        return {
+            "t": "tuple" if isinstance(obj, tuple) else "list",
+            "items": [_encode(x, arrays) for x in obj],
+        }
+    if isinstance(obj, dict):
+        bad = [k for k in obj if not isinstance(k, str)]
+        if bad:
+            raise TypeError(
+                f"checkpoint dicts need string keys; got {type(bad[0]).__name__}"
+            )
+        return {"t": "dict", "items": {k: _encode(v, arrays) for k, v in obj.items()}}
+    if isinstance(obj, enum.Enum):
+        key = _KEY_OF.get(type(obj))
+        if key is None:
+            raise TypeError(
+                f"enum {type(obj).__name__} is not checkpoint-registered; "
+                "call register_checkpoint_node"
+            )
+        return {"t": "enum", "cls": key, "v": obj.value}
+    key = _KEY_OF.get(type(obj))
+    if key is not None and dataclasses.is_dataclass(obj):
+        return {
+            "t": "node",
+            "cls": key,
+            "fields": {
+                f.name: _encode(getattr(obj, f.name), arrays)
+                for f in dataclasses.fields(obj)
+            },
+        }
+    raise TypeError(
+        f"cannot checkpoint object of type {type(obj).__name__}: not a "
+        "primitive/array/container and not registered via "
+        "register_checkpoint_node"
+    )
+
+
+def _decode(spec: Any, z) -> Any:
+    t = spec["t"]
+    if t == "lit":
+        return spec["v"]
+    if t in ("array", "scalar"):
+        arr = z[f"leaf_{spec['i']}"]
+        if list(arr.shape) != spec["shape"] or (
+            spec["dtype"] != "bfloat16" and str(arr.dtype) != spec["dtype"]
+        ):
+            raise ValueError(
+                f"checkpoint corrupt: leaf {spec['i']} is "
+                f"{arr.dtype}{arr.shape}, manifest says "
+                f"{spec['dtype']}{tuple(spec['shape'])}"
+            )
+        if spec["dtype"] == "bfloat16":
+            return jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
+        if t == "scalar":
+            return arr[()].item()
+        # Device arrays on save → device arrays on restore (solvers rely on
+        # jnp semantics like .at[]).
+        return jnp.asarray(arr)
+    if t == "list":
+        return [_decode(x, z) for x in spec["items"]]
+    if t == "tuple":
+        return tuple(_decode(x, z) for x in spec["items"])
+    if t == "dict":
+        return {k: _decode(v, z) for k, v in spec["items"].items()}
+    if t == "enum":
+        cls = _REGISTRY.get(spec["cls"])
+        if cls is None:
+            raise ValueError(f"unknown checkpoint enum key {spec['cls']!r}")
+        return cls(spec["v"])
+    if t == "node":
+        cls = _REGISTRY.get(spec["cls"])
+        if cls is None:
+            raise ValueError(
+                f"unknown checkpoint node key {spec['cls']!r} — register it "
+                "with register_checkpoint_node"
+            )
+        fields = {k: _decode(v, z) for k, v in spec["fields"].items()}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(fields) - known
+        if unknown:
+            raise ValueError(
+                f"checkpoint field(s) {sorted(unknown)} not on "
+                f"{cls.__name__} — incompatible schema change"
+            )
+        return cls(**fields)
+    raise ValueError(f"unknown checkpoint tag {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
 
 
 def save_checkpoint(directory: str, state: Any, step: int) -> str:
-    """Persist a pytree ``state`` as step ``step``. Returns the file path."""
+    """Persist ``state`` (containers + arrays + registered framework
+    objects) as step ``step``. Returns the file path."""
+    if not _REGISTRY:
+        _register_builtin_nodes()
     os.makedirs(directory, exist_ok=True)
-    leaves, treedef = jax.tree_util.tree_flatten(state)
-    arrays = {}
-    dtypes = []
-    for i, leaf in enumerate(leaves):
-        arr, dt = _to_saveable(leaf)
-        arrays[f"leaf_{i}"] = arr
-        dtypes.append(dt)
-    payload = dict(
-        treedef=pickle.dumps(treedef),
-        dtypes=dtypes,
-        num_leaves=len(leaves),
-    )
+    arrays: list = []
+    manifest = {"version": _FORMAT_VERSION, "root": _encode(state, arrays)}
     path = os.path.join(directory, f"step_{step}.npz")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        np.savez(f, __meta__=np.frombuffer(pickle.dumps(payload), np.uint8), **arrays)
+        np.savez(
+            f,
+            __manifest__=np.frombuffer(
+                json.dumps(manifest).encode(), np.uint8
+            ),
+            **{f"leaf_{i}": a for i, a in enumerate(arrays)},
+        )
     os.replace(tmp, path)  # atomic publish — no torn checkpoints on preemption
     latest_tmp = os.path.join(directory, _LATEST + ".tmp")
     with open(latest_tmp, "w") as f:
@@ -78,30 +244,24 @@ def latest_step(directory: str) -> Optional[int]:
 
 
 def load_checkpoint(directory: str, step: Optional[int] = None) -> Tuple[Any, int]:
-    """Load a checkpoint (latest by default) back into typed pytree objects."""
+    """Load a checkpoint (latest by default) back into typed objects.
+    Only JSON + numpy arrays are read — no pickle, no code execution."""
+    if not _REGISTRY:
+        _register_builtin_nodes()
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-    with np.load(os.path.join(directory, f"step_{step}.npz"), allow_pickle=True) as z:
-        payload = pickle.loads(z["__meta__"].tobytes())
-        treedef = pickle.loads(payload["treedef"])
-        leaves = []
-        for i, dt in enumerate(payload["dtypes"]):
-            arr = z[f"leaf_{i}"]
-            if dt == "bfloat16":
-                arr = jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
-            elif arr.ndim == 0 and arr.dtype == object:
-                arr = arr.item()
-            elif arr.ndim == 0 and arr.dtype.kind in ("U", "S", "b"):
-                arr = arr.item()  # strings / bools round-trip as themselves
-            elif arr.ndim == 0 and arr.dtype in (np.float64, np.int64):
-                # Host python scalars (metric values, counters) round-trip as
-                # scalars — jnp would silently downcast float64 with x64 off.
-                arr = arr.item()
-            else:
-                # Device arrays on save → device arrays on restore (solvers
-                # rely on jnp semantics like .at[]).
-                arr = jnp.asarray(arr)
-            leaves.append(arr)
-    return jax.tree_util.tree_unflatten(treedef, leaves), step
+    # allow_pickle stays False (numpy default): object arrays are rejected.
+    with np.load(os.path.join(directory, f"step_{step}.npz")) as z:
+        if "__manifest__" not in z:
+            raise ValueError(
+                "legacy (pickle-based) checkpoint format — not loadable by "
+                "this version; retrain or re-save"
+            )
+        manifest = json.loads(z["__manifest__"].tobytes().decode())
+        if manifest.get("version") != _FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported checkpoint version {manifest.get('version')}"
+            )
+        return _decode(manifest["root"], z), step

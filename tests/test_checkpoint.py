@@ -179,3 +179,78 @@ def test_cd_completed_run_replays_from_checkpoint(tmp_path):
         np.asarray(first.model.models["global"].model.coefficients.means),
         np.asarray(again.model.models["global"].model.coefficients.means),
     )
+
+
+def test_checkpoint_survives_class_rename(tmp_path):
+    """The registry key, not the class name, is the durable identity: a
+    renamed class re-registered under the same key loads old checkpoints
+    (VERDICT r2 #10 done-criterion)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.utils import checkpoint as ckpt
+
+    state = {"c": Coefficients(jnp.arange(4, dtype=jnp.float32))}
+    ckpt.save_checkpoint(str(tmp_path), state, 0)
+
+    # Simulate a refactor: the class was renamed/moved; key stays stable.
+    @jax.tree_util.register_dataclass
+    @dataclasses.dataclass(frozen=True)
+    class RenamedCoefficients:
+        means: object
+        variances: object = None
+
+    old = ckpt._REGISTRY["coefficients"]
+    try:
+        ckpt.register_checkpoint_node("coefficients", RenamedCoefficients)
+        loaded, step = ckpt.load_checkpoint(str(tmp_path))
+        assert isinstance(loaded["c"], RenamedCoefficients)
+        np.testing.assert_array_equal(np.asarray(loaded["c"].means), np.arange(4))
+    finally:
+        ckpt.register_checkpoint_node("coefficients", old)
+
+
+def test_checkpoint_rejects_unregistered_and_pickle(tmp_path):
+    """No pickle on either path: unregistered classes fail at SAVE, and
+    object-dtype arrays (the npz pickle vector) fail at LOAD."""
+    from photon_tpu.utils import checkpoint as ckpt
+
+    class Evil:
+        pass
+
+    with pytest.raises(TypeError, match="not registered"):
+        ckpt.save_checkpoint(str(tmp_path), {"x": Evil()}, 0)
+
+    # A hand-crafted npz smuggling a pickled object array must not execute:
+    # numpy refuses object arrays without allow_pickle.
+    import json as _json
+
+    manifest = {"version": 2, "root": {"t": "array", "i": 0, "shape": [], "dtype": "object"}}
+    evil_path = tmp_path / "step_7.npz"
+    np.savez(
+        evil_path,
+        __manifest__=np.frombuffer(_json.dumps(manifest).encode(), np.uint8),
+        leaf_0=np.array({"pwn": True}, dtype=object),
+    )
+    (tmp_path / "LATEST").write_text("7")
+    with pytest.raises(ValueError):
+        ckpt.load_checkpoint(str(tmp_path))
+
+
+def test_checkpoint_shape_validation(tmp_path):
+    """Manifest shape/dtype mismatches are detected, not silently loaded."""
+    import jax.numpy as jnp
+
+    from photon_tpu.utils import checkpoint as ckpt
+
+    path = ckpt.save_checkpoint(str(tmp_path), {"a": jnp.ones((3,))}, 0)
+    import zipfile
+
+    # Corrupt: replace the leaf with a different-shaped array.
+    data = dict(np.load(path))
+    data["leaf_0"] = np.ones((5,), np.float32)
+    np.savez(path, **data)
+    with pytest.raises(ValueError, match="manifest"):
+        ckpt.load_checkpoint(str(tmp_path), 0)

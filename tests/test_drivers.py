@@ -229,3 +229,33 @@ def test_game_training_hyperparameter_tuning(fixture_dir, tmp_path):
     records = json.loads(obs_path.read_text())["records"]
     assert len(records) == 2 + 6  # grid priors + tuned candidates
     assert all("global.weight" in r and "evaluationValue" in r for r in records)
+
+
+def test_summarization_output(fixture_dir, tmp_path):
+    """--summarization-output-dir writes FeatureSummarizationResultAvro
+    readable by the from-spec codec (writeBasicStatistics role,
+    ModelProcessingUtils.scala:516)."""
+    from photon_tpu.io.avro import read_avro_records
+
+    out = tmp_path / "out"
+    summ = tmp_path / "summ"
+    args = game_training.build_parser().parse_args(
+        [
+            "--input-paths", str(fixture_dir / "train.avro"),
+            "--output-dir", str(out),
+            "--feature-shard-configurations", "name=s",
+            "--coordinate-configurations", "name=global,feature.shard=s,reg.weights=1",
+            "--update-sequence", "global",
+            "--evaluators",
+            "--summarization-output-dir", str(summ),
+        ]
+    )
+    game_training.run(args)
+    recs = read_avro_records(str(summ / "s" / "part-00000.avro"))
+    by_name = {r["featureName"]: r["metrics"] for r in recs}
+    assert "x0" in by_name and "(INTERCEPT)" in by_name
+    m = by_name["x0"]
+    assert set(m) == {"mean", "variance", "min", "max", "normL1", "normL2", "numNonzeros"}
+    assert m["max"] >= m["min"]
+    assert by_name["(INTERCEPT)"]["mean"] == pytest.approx(1.0)
+    assert m["numNonzeros"] > 0
