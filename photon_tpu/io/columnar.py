@@ -1,0 +1,297 @@
+"""Columnar Avro ingest: native block decode + vectorized batch assembly.
+
+The row-oriented reader (io/avro.py + io/data_reader.py) walks every record
+field-by-field in Python — fine for model files, too slow to keep a TPU fed
+(SURVEY.md §7 hard part #4: ingest throughput). This module decodes container
+blocks into COLUMNS in one C++ pass (photon_tpu/native/avro_decode.cpp):
+numeric columns, interned string columns, feature bags as CSR
+(offsets/key-ids/values) and metadata triplets, with all string interning
+done natively. Python's remaining work is vectorized numpy: one IndexMap
+lookup per DISTINCT feature key, one scatter per shard.
+
+Falls back to the pure-Python codec whenever the native library is missing
+or the writer schema doesn't fit the supported program (the caller sees
+identical results either way — parity-tested).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import subprocess
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_tpu.io.avro import MAGIC, SYNC_SIZE, _Codec, _META_SCHEMA, _Reader
+
+# Program opcodes (avro_decode.cpp header).
+_OP_DOUBLE, _OP_OPT_DOUBLE, _OP_STR, _OP_OPT_STR = 0, 1, 2, 3
+_OP_BAG, _OP_OPT_MAP, _OP_MAP, _OP_FLOAT, _OP_LONG = 4, 5, 6, 7, 8
+
+
+@dataclasses.dataclass
+class FeatureBagColumn:
+    offsets: np.ndarray  # (n+1,) int64 CSR row offsets
+    key_ids: np.ndarray  # (nnz,) int32 interned feature keys
+    values: np.ndarray  # (nnz,) float64
+
+
+@dataclasses.dataclass
+class ColumnarRows:
+    """Struct-of-arrays view of a training-row file set."""
+
+    n: int
+    numeric: Dict[str, np.ndarray]  # field -> float64, NaN where null
+    strings: Dict[str, np.ndarray]  # field -> int32 intern ids, -1 null
+    bags: Dict[str, FeatureBagColumn]
+    meta_rows: np.ndarray  # (m,) int32 record index
+    meta_keys: np.ndarray  # (m,) int32 intern ids (metadata key)
+    meta_vals: np.ndarray  # (m,) int32 intern ids (metadata value)
+    intern: List[str]  # id -> string
+
+    def meta_column(self, name: str) -> np.ndarray:
+        """Per-record intern id of metadataMap[name] (-1 where absent)."""
+        out = np.full(self.n, -1, np.int32)
+        try:
+            key_id = self.intern.index(name)
+        except ValueError:
+            return out
+        sel = self.meta_keys == key_id
+        out[self.meta_rows[sel]] = self.meta_vals[sel]
+        return out
+
+
+def _lib_path() -> str:
+    return os.path.join(
+        os.path.dirname(__file__), "..", "native", "libavro_decode.so"
+    )
+
+
+_lib = None
+_lib_failed = False
+
+
+def _load_lib():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    so = os.path.abspath(_lib_path())
+    src = os.path.join(os.path.dirname(so), "avro_decode.cpp")
+    if not os.path.exists(so) or (
+        os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(so)
+    ):
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, src],
+                check=True, capture_output=True,
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            _lib_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        _lib_failed = True
+        return None
+    lib.avro_dec_new.restype = ctypes.c_void_p
+    lib.avro_dec_new.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.avro_dec_block.restype = ctypes.c_int
+    lib.avro_dec_block.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+    ]
+    for name, res in [
+        ("avro_dec_num_records", ctypes.c_int64),
+        ("avro_dec_numeric", ctypes.POINTER(ctypes.c_double)),
+        ("avro_dec_strcol", ctypes.POINTER(ctypes.c_int32)),
+        ("avro_dec_bag_len", ctypes.c_int64),
+        ("avro_dec_bag_offsets", ctypes.POINTER(ctypes.c_int64)),
+        ("avro_dec_bag_keys", ctypes.POINTER(ctypes.c_int32)),
+        ("avro_dec_bag_values", ctypes.POINTER(ctypes.c_double)),
+        ("avro_dec_meta_len", ctypes.c_int64),
+        ("avro_dec_meta_rows", ctypes.POINTER(ctypes.c_int32)),
+        ("avro_dec_meta_keys", ctypes.POINTER(ctypes.c_int32)),
+        ("avro_dec_meta_vals", ctypes.POINTER(ctypes.c_int32)),
+        ("avro_dec_intern_count", ctypes.c_int64),
+        ("avro_dec_intern_blob_len", ctypes.c_int64),
+        ("avro_dec_intern_blob", ctypes.POINTER(ctypes.c_char)),
+        ("avro_dec_intern_offsets", ctypes.POINTER(ctypes.c_int64)),
+    ]:
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = (
+            [ctypes.c_void_p, ctypes.c_int]
+            if name in ("avro_dec_numeric", "avro_dec_strcol", "avro_dec_bag_len",
+                        "avro_dec_bag_offsets", "avro_dec_bag_keys",
+                        "avro_dec_bag_values")
+            else [ctypes.c_void_p]
+        )
+    lib.avro_dec_free.argtypes = [ctypes.c_void_p]
+    lib.avro_dec_free.restype = None
+    _lib = lib
+    return lib
+
+
+def _type_name(t) -> Optional[str]:
+    if isinstance(t, str):
+        return t
+    if isinstance(t, dict):
+        return t.get("type")
+    return None
+
+
+def _is_feature_bag(t) -> bool:
+    if not (isinstance(t, dict) and t.get("type") == "array"):
+        return False
+    items = t.get("items")
+    if isinstance(items, str):  # by-name reference to a prior record def
+        return items.split(".")[-1] in ("FeatureAvro", "NameTermValueAvro")
+    if not (isinstance(items, dict) and items.get("type") == "record"):
+        return False
+    fields = items.get("fields", [])
+    return (
+        len(fields) == 3
+        and [f["name"] for f in fields] == ["name", "term", "value"]
+        and [_type_name(f["type"]) for f in fields] == ["string", "string", "double"]
+    )
+
+
+def compile_program(schema) -> Optional[Tuple[bytes, List[str]]]:
+    """Writer schema → (opcode bytes, field names), or None if unsupported."""
+    if not (isinstance(schema, dict) and schema.get("type") == "record"):
+        return None
+    ops: List[int] = []
+    names: List[str] = []
+    for f in schema.get("fields", []):
+        t = f["type"]
+        if t == "double":
+            ops.append(_OP_DOUBLE)
+        elif t == "float":
+            ops.append(_OP_FLOAT)
+        elif t in ("int", "long"):
+            ops.append(_OP_LONG)
+        elif t == "string":
+            ops.append(_OP_STR)
+        elif isinstance(t, list) and t == ["null", "double"]:
+            ops.append(_OP_OPT_DOUBLE)
+        elif isinstance(t, list) and t == ["null", "string"]:
+            ops.append(_OP_OPT_STR)
+        elif _is_feature_bag(t):
+            ops.append(_OP_BAG)
+        elif (
+            isinstance(t, list)
+            and len(t) == 2
+            and t[0] == "null"
+            and isinstance(t[1], dict)
+            and t[1].get("type") == "map"
+            and t[1].get("values") == "string"
+        ):
+            ops.append(_OP_OPT_MAP)
+        elif isinstance(t, dict) and t.get("type") == "map" and t.get("values") == "string":
+            ops.append(_OP_MAP)
+        else:
+            return None
+        names.append(f["name"])
+    return bytes(ops), names
+
+
+def _iter_blocks(path: str):
+    """Yield (count, decompressed bytes) per container block + the schema."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:4] != MAGIC:
+        raise ValueError("not an Avro object container file")
+    r = _Reader(raw)
+    r.pos = 4
+    meta = _Codec(_META_SCHEMA).decode(r)
+    import json
+
+    schema = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported avro codec {codec}")
+    sync = r.read_fixed(SYNC_SIZE)
+    blocks = []
+    n_total = len(r.buf)
+    while r.pos < n_total:
+        count = r.read_long()
+        size = r.read_long()
+        data = r.read_fixed(size)
+        if codec == "deflate":
+            data = zlib.decompress(data, -15)
+        if r.read_fixed(SYNC_SIZE) != sync:
+            raise ValueError("bad sync marker (corrupt file)")
+        blocks.append((count, data))
+    return schema, blocks
+
+
+def read_avro_columnar(paths: Sequence[str]) -> Optional[ColumnarRows]:
+    """Decode container files into columns via the native decoder.
+    Returns None when the native path is unavailable or the schema is
+    outside the supported program (callers fall back to rows)."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    file_blocks = []
+    program = names = None
+    for path in paths:
+        schema, blocks = _iter_blocks(path)
+        compiled = compile_program(schema)
+        if compiled is None:
+            return None
+        if program is None:
+            program, names = compiled
+        elif compiled[0] != program or compiled[1] != names:
+            return None  # heterogeneous schemas: keep it simple, fall back
+        file_blocks.extend(blocks)
+
+    ctx = lib.avro_dec_new(program, len(program))
+    try:
+        for count, data in file_blocks:
+            rc = lib.avro_dec_block(ctx, data, len(data), count)
+            if rc != 0:
+                return None  # malformed vs program: fall back to Python codec
+        n = int(lib.avro_dec_num_records(ctx))
+
+        def arr(ptr, count, dtype):
+            if count == 0:
+                return np.empty(0, dtype)
+            return np.ctypeslib.as_array(ptr, shape=(count,)).astype(dtype, copy=True)
+
+        numeric: Dict[str, np.ndarray] = {}
+        strings: Dict[str, np.ndarray] = {}
+        bags: Dict[str, FeatureBagColumn] = {}
+        for i, op in enumerate(program):
+            fname = names[i]
+            if op in (_OP_DOUBLE, _OP_OPT_DOUBLE, _OP_FLOAT, _OP_LONG):
+                numeric[fname] = arr(lib.avro_dec_numeric(ctx, i), n, np.float64)
+            elif op in (_OP_STR, _OP_OPT_STR):
+                strings[fname] = arr(lib.avro_dec_strcol(ctx, i), n, np.int32)
+            elif op == _OP_BAG:
+                nnz = int(lib.avro_dec_bag_len(ctx, i))
+                bags[fname] = FeatureBagColumn(
+                    offsets=arr(lib.avro_dec_bag_offsets(ctx, i), n + 1, np.int64),
+                    key_ids=arr(lib.avro_dec_bag_keys(ctx, i), nnz, np.int32),
+                    values=arr(lib.avro_dec_bag_values(ctx, i), nnz, np.float64),
+                )
+        m = int(lib.avro_dec_meta_len(ctx))
+        meta_rows = arr(lib.avro_dec_meta_rows(ctx), m, np.int32)
+        meta_keys = arr(lib.avro_dec_meta_keys(ctx), m, np.int32)
+        meta_vals = arr(lib.avro_dec_meta_vals(ctx), m, np.int32)
+
+        n_intern = int(lib.avro_dec_intern_count(ctx))
+        blob_len = int(lib.avro_dec_intern_blob_len(ctx))
+        blob = ctypes.string_at(lib.avro_dec_intern_blob(ctx), blob_len)
+        offs = arr(lib.avro_dec_intern_offsets(ctx), n_intern + 1, np.int64)
+        intern = [
+            blob[offs[i]:offs[i + 1]].decode("utf-8") for i in range(n_intern)
+        ]
+        return ColumnarRows(
+            n=n, numeric=numeric, strings=strings, bags=bags,
+            meta_rows=meta_rows, meta_keys=meta_keys, meta_vals=meta_vals,
+            intern=intern,
+        )
+    finally:
+        lib.avro_dec_free(ctx)

@@ -169,6 +169,147 @@ def rows_to_game_batch(
     return batch, entity_indexes
 
 
+def _columnar_index_maps(
+    cols, shard_configs: Dict[str, FeatureShardConfig]
+) -> Dict[str, IndexMap]:
+    maps: Dict[str, IndexMap] = {}
+    for shard, cfg in shard_configs.items():
+        ids: List[np.ndarray] = [
+            cols.bags[bag].key_ids
+            for bag in cfg.feature_bags
+            if bag in cols.bags
+        ]
+        uniq = (
+            np.unique(np.concatenate(ids)) if ids else np.empty(0, np.int32)
+        )
+        maps[shard] = IndexMap.build(
+            (cols.intern[i] for i in uniq), add_intercept=cfg.has_intercept
+        )
+    return maps
+
+
+def _columnar_to_game_batch(
+    cols,
+    shard_configs: Dict[str, FeatureShardConfig],
+    index_maps: Dict[str, IndexMap],
+    entity_id_columns: Optional[Dict[str, str]] = None,
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    intern_new_entities: bool = True,
+) -> Tuple[GameBatch, Dict[str, EntityIndex]]:
+    """Vectorized rows_to_game_batch over native-decoded columns: one
+    IndexMap lookup per DISTINCT key, numpy scatters for the matrices."""
+    n = cols.n
+    entity_id_columns = entity_id_columns or {}
+    entity_indexes = entity_indexes or {}
+
+    label_col = cols.numeric.get("label", cols.numeric.get("response"))
+    label = np.nan_to_num(
+        np.zeros(n, np.float64) if label_col is None else label_col, nan=0.0
+    ).astype(np.float32)
+    off_col = cols.numeric.get("offset")
+    offset = (
+        np.zeros(n, np.float32)
+        if off_col is None
+        else np.nan_to_num(off_col, nan=0.0).astype(np.float32)
+    )
+    wt_col = cols.numeric.get("weight")
+    weight = (
+        np.ones(n, np.float32)
+        if wt_col is None
+        else np.nan_to_num(wt_col, nan=1.0).astype(np.float32)
+    )
+
+    features: Dict[str, object] = {}
+    for shard, cfg in shard_configs.items():
+        imap = index_maps[shard]
+        d = len(imap)
+        icpt = imap.get_index(INTERCEPT_KEY) if cfg.has_intercept else -1
+        # One lookup per distinct interned string (metadata strings resolve
+        # to -1 and are masked out below).
+        feat_of = np.fromiter(
+            (imap.get_index(s) for s in cols.intern),
+            np.int32,
+            count=len(cols.intern),
+        )
+        row_idx_parts, col_idx_parts, val_parts = [], [], []
+        for bag_name in cfg.feature_bags:
+            bag = cols.bags.get(bag_name)
+            if bag is None or bag.key_ids.size == 0:
+                continue
+            rows_of = np.repeat(
+                np.arange(n, dtype=np.int64), np.diff(bag.offsets)
+            )
+            j = feat_of[bag.key_ids]
+            ok = j >= 0
+            row_idx_parts.append(rows_of[ok])
+            col_idx_parts.append(j[ok])
+            val_parts.append(bag.values[ok].astype(np.float32))
+        rows_all = (
+            np.concatenate(row_idx_parts) if row_idx_parts else np.empty(0, np.int64)
+        )
+        cols_all = (
+            np.concatenate(col_idx_parts) if col_idx_parts else np.empty(0, np.int32)
+        )
+        vals_all = (
+            np.concatenate(val_parts) if val_parts else np.empty(0, np.float32)
+        )
+        if d <= cfg.dense_dim_limit:
+            X = np.zeros((n, d), np.float32)
+            X[rows_all, cols_all] = vals_all  # duplicate keys: last wins,
+            # matching the row path's overwrite semantics
+            if icpt >= 0:
+                X[:, icpt] = 1.0
+            features[shard] = jnp.asarray(X)
+        else:
+            # Padded-sparse, built without any per-row Python loop.
+            counts = np.bincount(rows_all, minlength=n).astype(np.int64)
+            if icpt >= 0:
+                counts += 1
+            max_nnz = max(int(counts.max()) if n else 1, 1)
+            order = np.argsort(rows_all, kind="stable")
+            r_s, c_s, v_s = rows_all[order], cols_all[order], vals_all[order]
+            starts = np.zeros(n + 1, np.int64)
+            np.cumsum(np.bincount(r_s, minlength=n), out=starts[1:])
+            pos = np.arange(r_s.size, dtype=np.int64) - starts[r_s]
+            indices = np.full((n, max_nnz), -1, np.int32)
+            values = np.zeros((n, max_nnz), np.float32)
+            indices[r_s, pos] = c_s
+            values[r_s, pos] = v_s
+            if icpt >= 0:
+                slot = counts - 1
+                indices[np.arange(n), slot] = icpt
+                values[np.arange(n), slot] = 1.0
+            features[shard] = SparseFeatures(
+                jnp.asarray(indices), jnp.asarray(values), d
+            )
+
+    entity_ids: Dict[str, np.ndarray] = {}
+    for re_type, col in entity_id_columns.items():
+        eidx = entity_indexes.setdefault(re_type, EntityIndex())
+        raw = cols.meta_column(col)
+        if col in cols.strings:  # top-level field fallback (GameConverters)
+            raw = np.where(raw >= 0, raw, cols.strings[col])
+        ids = np.full(n, -1, np.int32)
+        present = np.unique(raw[raw >= 0])
+        lut = np.full(len(cols.intern), -1, np.int32)
+        for iid in present:
+            s = cols.intern[iid]
+            lut[iid] = eidx.intern(s) if intern_new_entities else eidx.lookup(s)
+        sel = raw >= 0
+        ids[sel] = lut[raw[sel]]
+        entity_ids[re_type] = ids
+
+    batch = GameBatch(
+        label=jnp.asarray(label),
+        offset=jnp.asarray(offset),
+        weight=jnp.asarray(weight),
+        features=features,
+        entity_ids={k: jnp.asarray(v) for k, v in entity_ids.items()},
+        uid=jnp.asarray(np.arange(n, dtype=np.int64)),
+    )
+    return batch, entity_indexes
+
+
 def read_merged(
     paths: Sequence[str],
     shard_configs: Dict[str, FeatureShardConfig],
@@ -176,9 +317,26 @@ def read_merged(
     entity_id_columns: Optional[Dict[str, str]] = None,
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
     intern_new_entities: bool = True,
+    use_columnar: bool = True,
 ) -> Tuple[GameBatch, Dict[str, IndexMap], Dict[str, EntityIndex]]:
     """DataReader.readMerged role: read Avro files → GameBatch (+ created
-    index maps when not supplied)."""
+    index maps when not supplied). Prefers the native columnar decode path
+    (io/columnar.py); row-oriented pure Python is the universal fallback."""
+    if use_columnar:
+        from photon_tpu.io.columnar import read_avro_columnar
+
+        try:
+            cols = read_avro_columnar(_expand_paths(paths))
+        except (ValueError, OSError):
+            cols = None
+        if cols is not None:
+            if index_maps is None:
+                index_maps = _columnar_index_maps(cols, shard_configs)
+            batch, entity_indexes = _columnar_to_game_batch(
+                cols, shard_configs, index_maps, entity_id_columns,
+                entity_indexes, intern_new_entities,
+            )
+            return batch, index_maps, entity_indexes
     rows = read_avro_rows(paths)
     if index_maps is None:
         index_maps = build_index_maps(rows, shard_configs)
