@@ -38,6 +38,7 @@ Configs (BASELINE.md "Benchmark configs to stand up"):
 
 from __future__ import annotations
 
+import hashlib
 import os
 import time
 from typing import Dict, List, Optional, Tuple
@@ -47,11 +48,53 @@ import numpy as np
 # Pinned CPU baselines (samples/sec for 1-3, wall seconds for 5), measured
 # 2026-07-29 on the build image via `python bench.py --measure-cpu-baseline-all`.
 CPU_BASELINES: Dict[str, float] = {
+    "glmix_headline_sps": 1.302e7,  # bench.BASELINE_SAMPLES_PER_SEC
     "libsvm_sweep_sps": 2.393e7,
     "tron_linear_sps": 1.173e7,
     "poisson_owlqn_sps": 1.069e7,
     "game_tune_wall_s": 206.2,
+    # scipy L-BFGS-B on CSR (2^20×2^20, 64 nnz/row): 23.23s, 38 evals.
+    "sparse_wide_sps": 3.431e6,
 }
+
+
+def workload_fp(*parts) -> str:
+    """Fingerprint of the workload-defining constants. Pinned next to each
+    CPU baseline; a mismatch means the workload changed after the baseline
+    was measured, so ``vs_baseline`` would silently lie (VERDICT r3 weak #7).
+    """
+    return hashlib.sha1(repr(parts).encode()).hexdigest()[:12]
+
+
+# Fingerprints captured when the CPU baselines above were measured. If a
+# workload constant changes, re-run `python bench.py --measure-cpu-baseline-all`
+# and re-pin BOTH the baseline and its fingerprint.
+PINNED_FPS: Dict[str, str] = {
+    "glmix_headline_sps": "a89930dacf11",
+    "libsvm_sweep_sps": "79c950d0e9a4",
+    "tron_linear_sps": "672690cf2d1b",
+    "poisson_owlqn_sps": "aecb962224bd",
+    "sparse_wide_sps": "63836e95844b",
+    "game_tune_wall_s": "68d65b80e022",
+}
+
+
+def baseline_ratio(
+    key: str, fp: str, measured: Optional[float], *, lower_is_better: bool = False
+) -> dict:
+    """vs_baseline fields for a measured value, guarded by the workload
+    fingerprint (division and the no-baseline guard live HERE, once)."""
+    pinned = PINNED_FPS.get(key)
+    base = CPU_BASELINES.get(key)
+    if pinned != fp or not base or not measured:
+        return {
+            "vs_baseline": None,
+            "baseline_stale": True,
+            "workload_fp": fp,
+            "pinned_fp": pinned,
+        }
+    ratio = (base / measured) if lower_is_better else (measured / base)
+    return {"vs_baseline": round(ratio, 3), "workload_fp": fp}
 
 _A9A_PATH = (
     "/root/reference/photon-client/src/integTest/resources/DriverIntegTest/input/a9a"
@@ -128,11 +171,12 @@ def run_libsvm_sweep() -> dict:
     dt = min(times)
     visits = int(ev) * n  # evals are x_passes summed over the k lanes
     sps = visits / dt
+    fp = workload_fp("libsvm_sweep", source, n, d, _SWEEP_LAMBDAS, _SWEEP_ITERS)
     return dict(
         metric="libsvm_logistic_sweep_samples_per_sec_per_chip",
         value=round(sps, 1),
         unit="samples/s",
-        vs_baseline=round(sps / CPU_BASELINES["libsvm_sweep_sps"], 3),
+        **baseline_ratio("libsvm_sweep_sps", fp, sps),
         data=source,
         n=n,
         d=d,
@@ -228,11 +272,12 @@ def run_tron_linear() -> dict:
     dt = min(times)
     visits = 2 * _TRON_N * int(ev)  # each f/g or H·v eval ≈ 2 X passes
     sps = visits / dt
+    fp = workload_fp("tron_linear", _TRON_N, _TRON_D, 15, 1e-5, 1)
     return dict(
         metric="tron_linear_l2_samples_per_sec_per_chip",
         value=round(sps, 1),
         unit="samples/s",
-        vs_baseline=round(sps / CPU_BASELINES["tron_linear_sps"], 3),
+        **baseline_ratio("tron_linear_sps", fp, sps),
         n=_TRON_N,
         d=_TRON_D,
         evals=int(ev),
@@ -336,11 +381,12 @@ def run_poisson_owlqn() -> dict:
     visits = 2 * _PO_N * int(ev)  # black-box evals: 2 X passes each
     sps = visits / dt
     nnz = int(jnp.sum(jnp.abs(w) > 1e-8))
+    fp = workload_fp("poisson_owlqn", _PO_N, _PO_D, _PO_L1, _PO_L2, 60, 2)
     return dict(
         metric="poisson_elastic_net_samples_per_sec_per_chip",
         value=round(sps, 1),
         unit="samples/s",
-        vs_baseline=round(sps / CPU_BASELINES["poisson_owlqn_sps"], 3),
+        **baseline_ratio("poisson_owlqn_sps", fp, sps),
         n=_PO_N,
         d=_PO_D,
         l1=_PO_L1,
@@ -389,6 +435,118 @@ def measure_cpu_poisson_owlqn() -> float:
     dt = time.perf_counter() - t0
     sps = 2 * n * r.nfev / dt
     print(f"# CPU Poisson-OWLQN baseline: {sps:.4g} samples/s ({dt:.2f}s, {r.nfev} evals)")
+    return sps
+
+
+# --------------------------------------------------------------------------
+# Config 6 (VERDICT r3 #4): sparse WIDE fixed effect — the path that carries
+# the reference's "hundreds of billions of coefficients" story
+# (/root/reference/README.md:56) scaled to one chip: n=2^20 rows, d=2^20
+# coefficients, 64 nnz/row in the padded-sparse SparseFeatures layout
+# (gather matvec + scatter-add rmatvec). Baseline: scipy L-BFGS-B over a
+# CSR matrix with the identical objective and visit accounting.
+# --------------------------------------------------------------------------
+
+_SP_N, _SP_D, _SP_K = 1 << 20, 1 << 20, 64
+_SP_ITERS = 30
+_SP_SEED = 3
+
+
+def _sparse_wide_data(seed=_SP_SEED):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, _SP_D, size=(_SP_N, _SP_K)).astype(np.int32)
+    vals = rng.normal(size=(_SP_N, _SP_K)).astype(np.float32)
+    idx[:, 0] = 0  # intercept slot: feature 0, value 1
+    vals[:, 0] = 1.0
+    w_true = (rng.normal(size=_SP_D) / 8.0).astype(np.float32)
+    z = np.sum(vals * w_true[idx], axis=1)
+    y = (rng.uniform(size=_SP_N) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    return idx, vals, y
+
+
+def run_sparse_wide() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.common import OptimizerConfig
+    from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
+
+    _progress("config 6: generating sparse wide data (2^20 × 2^20, 64 nnz/row)")
+    idx, vals, y = _sparse_wide_data()
+    feats = SparseFeatures(jnp.asarray(idx), jnp.asarray(vals), _SP_D)
+    batch = LabeledBatch(jnp.asarray(y), feats)
+    jax.block_until_ready(batch.features.values)
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0, intercept_index=0)
+    cfg = OptimizerConfig(max_iter=_SP_ITERS, track_history=False)
+
+    @jax.jit
+    def solve(w0):
+        res = minimize_lbfgs_margin(obj, batch, w0, cfg)
+        return res.w, res.evals
+
+    _progress("config 6: compiling + warm-up")
+    w, ev = solve(jnp.zeros(_SP_D, jnp.float32))
+    float(jnp.sum(w))
+    times = []
+    for rep in range(3):
+        t0 = time.perf_counter()
+        w, ev = solve(jnp.full((_SP_D,), 1e-6 * (rep + 1), jnp.float32))
+        float(jnp.sum(w))
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    visits = _SP_N * int(ev)  # evals count X passes directly (margin solver)
+    sps = visits / dt
+    # Modeled sparse traffic: one pass reads (idx int32 + vals f32) once;
+    # the gradient pass additionally scatters into a (d,) f32 accumulator.
+    nnz_bytes = _SP_N * _SP_K * 8
+    gbps = int(ev) * nnz_bytes / dt / 1e9
+    fp = workload_fp("sparse_wide", _SP_N, _SP_D, _SP_K, _SP_ITERS, _SP_SEED)
+    return dict(
+        metric="sparse_wide_logistic_samples_per_sec_per_chip",
+        value=round(sps, 1),
+        unit="samples/s",
+        **baseline_ratio("sparse_wide_sps", fp, sps),
+        n=_SP_N,
+        d=_SP_D,
+        nnz_per_row=_SP_K,
+        x_passes=int(ev),
+        wall_s=round(dt, 4),
+        nnz_traffic_gbps=round(gbps, 1),
+        baseline="scipy L-BFGS-B on CSR, measured on this image",
+    )
+
+
+def measure_cpu_sparse_wide() -> float:
+    import scipy.optimize
+    import scipy.sparse
+
+    idx, vals, y = _sparse_wide_data()
+    indptr = np.arange(_SP_N + 1, dtype=np.int64) * _SP_K
+    X = scipy.sparse.csr_matrix(
+        (vals.ravel(), idx.ravel().astype(np.int64), indptr), shape=(_SP_N, _SP_D)
+    )
+
+    def f_g(w):
+        w32 = w.astype(np.float32)
+        z = X @ w32
+        p = 1.0 / (1.0 + np.exp(-z))
+        reg_w = w32.copy()
+        reg_w[0] = 0.0
+        val = float(np.sum(np.logaddexp(0, z) - y * z)) + 0.5 * float(reg_w @ reg_w)
+        grad = X.T @ (p - y).astype(np.float32) + reg_w
+        return val, grad.astype(np.float64)
+
+    t0 = time.perf_counter()
+    r = scipy.optimize.minimize(
+        f_g, np.zeros(_SP_D), jac=True, method="L-BFGS-B",
+        options=dict(maxiter=_SP_ITERS),
+    )
+    dt = time.perf_counter() - t0
+    sps = 2 * _SP_N * r.nfev / dt
+    print(f"# CPU sparse-wide baseline: {sps:.4g} samples/s ({dt:.2f}s, {r.nfev} evals)")
     return sps
 
 
@@ -481,12 +639,13 @@ def run_game_tuning() -> dict:
     _progress("config 5: batched rounds (8 candidates / program)")
     dt_batch, best_b = _game_tune_pipeline(batch_size=_G_ROUNDS)
     dt = min(dt_seq, dt_batch)
-    base = CPU_BASELINES["game_tune_wall_s"]
+    fp = workload_fp("game_tune", _G_N, _G_DFIX, _G_DRE, _G_E, _G_ROUNDS)
     return dict(
         metric="game_bayes_tuning_wall_clock",
         value=round(dt, 2),
         unit="seconds",
-        vs_baseline=round(base / dt, 3),  # >1 = faster than CPU
+        # >1 = faster than CPU
+        **baseline_ratio("game_tune_wall_s", fp, dt, lower_is_better=True),
         rounds=_G_ROUNDS,
         n=_G_N,
         entities=_G_E,
@@ -528,19 +687,38 @@ def measure_cpu_game_tuning() -> float:
 # --------------------------------------------------------------------------
 
 
+# (metric name as emitted on success — error lines reuse it so failures
+# join the same metric series, per r4 review)
+EXTRA_CONFIGS = [
+    ("libsvm_logistic_sweep_samples_per_sec_per_chip", "run_libsvm_sweep"),
+    ("tron_linear_l2_samples_per_sec_per_chip", "run_tron_linear"),
+    ("poisson_elastic_net_samples_per_sec_per_chip", "run_poisson_owlqn"),
+    ("sparse_wide_logistic_samples_per_sec_per_chip", "run_sparse_wide"),
+    ("game_bayes_tuning_wall_clock", "run_game_tuning"),
+]
+
+
 def run_extra_configs() -> List[dict]:
-    return [
-        run_libsvm_sweep(),
-        run_tron_linear(),
-        run_poisson_owlqn(),
-        run_game_tuning(),
-    ]
+    """Run configs 1/2/3/6/5. One config failing yields an {"error": ...}
+    line instead of killing the whole evidence run (VERDICT r3 weak #2)."""
+    results = []
+    for name, fn_name in EXTRA_CONFIGS:
+        try:
+            results.append(globals()[fn_name]())
+        except Exception as exc:  # noqa: BLE001 — evidence must survive
+            results.append({
+                "metric": name,
+                "error": type(exc).__name__,
+                "detail": str(exc)[:300],
+            })
+    return results
 
 
 def measure_all_cpu_baselines() -> None:
-    print("# measuring CPU baselines for configs 1, 2, 3, 5 — pin these in "
+    print("# measuring CPU baselines for configs 1, 2, 3, 6, 5 — pin these in "
           "bench_configs.CPU_BASELINES")
     print(f"#   libsvm_sweep_sps = {measure_cpu_libsvm_sweep():.4g}")
     print(f"#   tron_linear_sps = {measure_cpu_tron_linear():.4g}")
     print(f"#   poisson_owlqn_sps = {measure_cpu_poisson_owlqn():.4g}")
+    print(f"#   sparse_wide_sps = {measure_cpu_sparse_wide():.4g}")
     print(f"#   game_tune_wall_s = {measure_cpu_game_tuning():.4g}")
