@@ -68,15 +68,29 @@ class FixedEffectCoordinate(Coordinate):
             if initial_model is not None
             else jnp.zeros((d,), lb.label.dtype)
         )
+        # Models live in MODEL space; solves run in the normalization-folded
+        # transformed space (reference Optimizer.scala:167 converts the warm
+        # start in, DistributedOptimizationProblem.scala:127 converts the
+        # result out).
+        norm = self.objective.normalization
+        folded = norm is not None and not norm.is_identity
+        if folded:
+            w0 = norm.model_to_transformed_space(w0)
         solve = make_optimizer(self.objective, self.optimizer_spec)
         result = solve(w0, lb)
         # SIMPLE/FULL variance computation
-        # (DistributedOptimizationProblem.scala:83-103 role).
+        # (DistributedOptimizationProblem.scala:83-103 role). Evaluated at
+        # the transformed-space optimum (self-consistent with the folded
+        # objective — the reference instead feeds model-space coefficients
+        # to the folded Hessian) and mapped to model space via factors².
         variances = coefficient_variances(
             self.objective, result.w, lb, self.compute_variance
         )
+        w_model = norm.transformed_to_model_space(result.w) if folded else result.w
+        if folded and variances is not None and norm.factors is not None:
+            variances = variances * norm.factors**2
         model = FixedEffectModel(
-            GeneralizedLinearModel(Coefficients(result.w, variances), self.task),
+            GeneralizedLinearModel(Coefficients(w_model, variances), self.task),
             self.feature_shard,
         )
         return model, result

@@ -128,8 +128,15 @@ def _solve_block(
         objective, spec, block.dim, has_mask=feature_mask is not None
     )
 
+    norm = objective.normalization
+    folded = norm is not None and not norm.is_identity
+
     def solve_one(feat, lab, wt, off, w_init, fmask, tmask):
         lb = LabeledBatch(lab, feat, off, wt)
+        # Models live in MODEL space; the folded objective optimizes in
+        # transformed space (reference SingleNodeOptimizationProblem.scala:95
+        # converts out, Optimizer.scala:167 converts the warm start in).
+        w_start = norm.model_to_transformed_space(w_init) if folded else w_init
         if feature_mask is not None:
             # Optimize f_m(w) = f(w ∘ m): chain rule masks the gradient and
             # sandwiches the Hessian (M H M) so every solver sees a
@@ -147,11 +154,11 @@ def _solve_block(
             l1_mask = None
             if objective.intercept_index is not None:
                 l1_mask = jnp.ones_like(w_init).at[objective.intercept_index].set(0.0)
-            res = minimize_owlqn(vg, w_init, objective.l1_weight, config, l1_mask)
+            res = minimize_owlqn(vg, w_start, objective.l1_weight, config, l1_mask)
         elif use_newton:
-            res = minimize_newton(objective, lb, w_init, config)
+            res = minimize_newton(objective, lb, w_start, config)
         elif spec.optimizer == OptimizerType.TRON:
-            res = minimize_tron(vg, hvp, w_init, config, spec.max_cg_iter)
+            res = minimize_tron(vg, hvp, w_start, config, spec.max_cg_iter)
         elif feature_mask is not None and (
             objective.normalization is not None
             and objective.normalization.shifts is not None
@@ -159,7 +166,7 @@ def _solve_block(
             # Shift normalization computes es over the FULL w, so masking X
             # columns does not silence masked coordinates (they'd train as
             # pseudo-intercepts). Keep the gradient-masked formulation.
-            res = minimize_lbfgs(vg, w_init, config)
+            res = minimize_lbfgs(vg, w_start, config)
         else:
             # Margin-space L-BFGS on the feature-masked batch: X∘m keeps the
             # GLM margin structure, and masked coordinates (appearing only in
@@ -170,8 +177,10 @@ def _solve_block(
                 if feature_mask is not None
                 else lb
             )
-            res = minimize_lbfgs_margin(objective, lb_m, w_init, config)
+            res = minimize_lbfgs_margin(objective, lb_m, w_start, config)
         w_out = res.w * fmask if feature_mask is not None else res.w
+        if folded:
+            w_out = norm.transformed_to_model_space(w_out)
         # Entities under the lower-bound filter keep their initial model
         # (reference filterActiveData semantics: not trained this pass).
         w_out = jnp.where(tmask, w_out, w_init)
@@ -328,7 +337,13 @@ class RandomEffectCoordinate(Coordinate):
             if self.compute_variance != VarianceComputationType.NONE:
                 def var_one(feat, lab, wt, off, w, _obj=obj):
                     lb = LabeledBatch(lab, feat, off, wt)
-                    return coefficient_variances(_obj, w, lb, self.compute_variance)
+                    bn = _obj.normalization
+                    bfolded = bn is not None and not bn.is_identity
+                    wv = bn.model_to_transformed_space(w) if bfolded else w
+                    v = coefficient_variances(_obj, wv, lb, self.compute_variance)
+                    if bfolded and v is not None and bn.factors is not None:
+                        v = v * bn.factors**2
+                    return v
 
                 block_vars.append(
                     jax.vmap(var_one)(
@@ -372,9 +387,16 @@ class RandomEffectCoordinate(Coordinate):
         E, d = self.dataset.num_entities, self.dataset.dim
         variances = jnp.ones((E, d), dtype)
 
+        norm = self.objective.normalization
+        folded = norm is not None and not norm.is_identity
+
         def var_one(feat, lab, wt, off, w):
             lb = LabeledBatch(lab, feat, off, wt)
-            return coefficient_variances(self.objective, w, lb, self.compute_variance)
+            wv = norm.model_to_transformed_space(w) if folded else w
+            v = coefficient_variances(self.objective, wv, lb, self.compute_variance)
+            if folded and v is not None and norm.factors is not None:
+                v = v * norm.factors**2
+            return v
 
         for block in self.dataset.blocks:
             offs = block.gather_offsets(total_offset)

@@ -141,6 +141,10 @@ class GameEstimator:
                     l2_weight=reg.l2,
                     l1_weight=reg.l1,
                     intercept_index=self.intercept_indices.get(cfg.feature_shard),
+                    # Same per-shard fold as the fixed effect (the reference
+                    # passes NormalizationContexts per shard to every
+                    # coordinate via CoordinateFactory).
+                    normalization=self.normalization.get(cfg.feature_shard),
                 )
                 coords[cfg.coordinate_id] = RandomEffectCoordinate(
                     coordinate_id=cfg.coordinate_id,

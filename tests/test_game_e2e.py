@@ -230,3 +230,86 @@ def test_tracker_wall_times_and_summary(glmix_data):
     assert {e.payload["coordinate"] for e in logs} == {"global", "per_user"}
     assert all(e.payload["wall_s"] > 0 for e in logs)
     assert any("loss" in e.payload["summary"] for e in logs)
+
+
+def test_normalization_folded_matches_explicit_pretransform():
+    """GAME fit with a folded NormalizationContext on RAW features must match
+    the same fit run WITHOUT normalization on explicitly standardized
+    features — models in both runs live in their feature space's model
+    coordinates, so validation scores coincide. Guards the reference's
+    convert-in/convert-out contract (Optimizer.scala:167,
+    DistributedOptimizationProblem.scala:127): before round 4 the estimator
+    stored transformed-space coefficients and scored raw features with them.
+    """
+    from photon_tpu.data.normalization import NormalizationContext
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        GameOptimizationConfig,
+        RandomEffectCoordinateConfig,
+        RegularizationConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+
+    rng2 = np.random.default_rng(42)
+    n, d_fix, d_re, e = 1024, 6, 3, 12
+    scales = np.array([1.0, 50.0, 0.02, 7.0, 300.0, 0.5], np.float32)
+    Xf = (rng2.normal(size=(n, d_fix)) * scales + 2.0 * scales).astype(np.float32)
+    Xf[:, 0] = 1.0
+    Xr = (rng2.normal(size=(n, d_re)) * np.array([1.0, 20.0, 0.1], np.float32)
+          ).astype(np.float32)
+    Xr[:, 0] = 1.0
+    users = rng2.integers(0, e, size=n).astype(np.int32)
+    logits = (Xf / (scales + 1.0)) @ rng2.normal(size=d_fix).astype(np.float32)
+    y = (rng2.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+
+    def mk_batch(Xf_, Xr_):
+        return GameBatch(
+            label=jnp.asarray(y),
+            offset=jnp.zeros(n, jnp.float32),
+            weight=jnp.ones(n, jnp.float32),
+            features={"global": jnp.asarray(Xf_), "per_user": jnp.asarray(Xr_)},
+            entity_ids={"userId": jnp.asarray(users)},
+        )
+
+    def std_ctx(X):
+        mean = X.mean(0)
+        std = X.std(0)
+        mean[0], std[0] = 0.0, 1.0
+        return NormalizationContext(
+            factors=jnp.asarray(1.0 / std), shifts=jnp.asarray(mean),
+            intercept_index=0,
+        ), (X - mean) / std
+
+    ctx_f, Xf_explicit = std_ctx(Xf.copy())
+    ctx_r, Xr_explicit = std_ctx(Xr.copy())
+
+    def fit(batch, normalization):
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs=[
+                FixedEffectCoordinateConfig("global", "global"),
+                RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+            ],
+            num_iterations=2,
+            intercept_indices={"global": 0, "per_user": 0},
+            num_entities={"userId": e},
+            normalization=normalization,
+        )
+        cfg = GameOptimizationConfig(reg={
+            "global": RegularizationConfig(weight=1.0),
+            "per_user": RegularizationConfig(weight=1.0),
+        })
+        (res,) = est.fit(batch, optimization_configs=[cfg])
+        return res.model
+
+    folded = fit(mk_batch(Xf, Xr),
+                 {"global": ctx_f, "per_user": ctx_r})
+    explicit = fit(mk_batch(Xf_explicit.astype(np.float32),
+                            Xr_explicit.astype(np.float32)), None)
+
+    s_folded = np.asarray(folded.score(mk_batch(Xf, Xr)))
+    s_explicit = np.asarray(
+        explicit.score(mk_batch(Xf_explicit.astype(np.float32),
+                                Xr_explicit.astype(np.float32)))
+    )
+    np.testing.assert_allclose(s_folded, s_explicit, rtol=2e-3, atol=2e-3)
