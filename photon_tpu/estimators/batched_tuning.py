@@ -12,16 +12,31 @@ natural TPU win over the reference.
 
 Eligibility (falls back to sequential fits otherwise): one fixed-effect +
 one random-effect coordinate (the GLMix shape), pure-L2 tuning dimensions,
-single unprojected entity block, no normalization/down-sampling/boxes/
-feature masks, and a jittable primary metric.
+unprojected entity blocks, no down-sampling/boxes/feature masks, and a
+jittable primary metric. Normalization-folded shards ARE eligible (r4): the
+per-shard fold is static per lane, and models convert between transformed
+and model space exactly as the production coordinates do. Every fallback is
+logged (VERDICT r3: a silent fallback makes "8 candidates per program"
+quietly mean 1).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Callable, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _decline(reason: str) -> None:
+    logger.warning(
+        "batched hyperparameter evaluation declined (%s); candidates will "
+        "be trained sequentially", reason,
+    )
+    return None
 
 from photon_tpu.estimators.config import (
     FixedEffectCoordinateConfig,
@@ -60,49 +75,50 @@ def build_batched_evaluator(
     values, or None when the setup is not batchable."""
     cfgs = estimator.coordinate_configs
     if len(cfgs) != 2:
-        return None
+        return _decline(
+            f"{len(cfgs)} coordinates; only the 2-coordinate GLMix shape "
+            "is batchable"
+        )
     fe_cfgs = [c for c in cfgs if isinstance(c, FixedEffectCoordinateConfig)]
     re_cfgs = [c for c in cfgs if isinstance(c, RandomEffectCoordinateConfig)]
     if len(fe_cfgs) != 1 or len(re_cfgs) != 1:
-        return None
+        return _decline("need exactly one fixed + one random effect")
     fe_cfg, re_cfg = fe_cfgs[0], re_cfgs[0]
     if estimator.update_sequence[0] != fe_cfg.coordinate_id:
-        return None  # program trains FE first
+        return _decline("update sequence does not train the fixed effect first")
     # Tuning dims must be pure-L2 weights (l2_override hook).
     if any(kind != "weight" for _, kind in ((s.coordinate_id, s.kind) for s in slots)):
-        return None
+        return _decline("non-L2-weight tuning dimension")
     if any(base_config.reg[c.coordinate_id].alpha != 0.0 for c in cfgs):
-        return None
+        return _decline("elastic-net alpha != 0")
     if (
         fe_cfg.down_sampling_rate is not None
         or getattr(fe_cfg, "box", None) is not None
         or re_cfg.features_to_samples_ratio is not None
     ):
-        return None
-    if estimator.normalization:
-        return None
+        return _decline("down-sampling / box constraints / Pearson masks")
     if estimator.locked_coordinates:
-        return None
+        return _decline("locked coordinates")
     primary = evaluation_suite.primary
     if primary.etype.name not in _JITTABLE_METRICS or primary.group_by is not None:
-        return None
+        return _decline(f"primary metric {primary.name} is not jittable")
     from photon_tpu.types import OptimizerType
 
     if fe_cfg.optimizer != OptimizerType.LBFGS:
-        return None  # batched program solves FE with margin-LBFGS
+        return _decline("fixed-effect optimizer is not LBFGS")
     if re_cfg.optimizer not in (OptimizerType.LBFGS, OptimizerType.NEWTON):
-        return None
+        return _decline("random-effect optimizer is not LBFGS/NEWTON")
 
     # Datasets: unprojected RE dataset (any block count).
     estimator._prepare_datasets(batch)
     ds = estimator._re_datasets.get(re_cfg.coordinate_id)
     if ds is None or ds.projected:
-        return None
+        return _decline("projected random-effect dataset")
 
     import jax
     import jax.numpy as jnp
 
-    from photon_tpu.algorithm.random_effect import NEWTON_AUTO_MAX_DIM
+    from photon_tpu.algorithm.random_effect import newton_eligible
     from photon_tpu.data.batch import LabeledBatch
     from photon_tpu.ops.losses import loss_for_task
     from photon_tpu.ops.objective import GLMObjective
@@ -114,15 +130,20 @@ def build_batched_evaluator(
     fe_icpt = estimator.intercept_indices.get(fe_shard)
     re_icpt = estimator.intercept_indices.get(re_shard)
     # Base λs: lanes override via l2_override, so the static weight only
-    # matters for coordinates without a tuning slot.
+    # matters for coordinates without a tuning slot. Normalization folds
+    # exactly as in the production coordinates (_build_coordinates).
+    fe_norm = estimator.normalization.get(fe_shard)
+    re_norm = estimator.normalization.get(re_shard)
     fe_obj = GLMObjective(
         loss=loss, l2_weight=base_config.reg[fe_cfg.coordinate_id].l2,
-        intercept_index=fe_icpt,
+        intercept_index=fe_icpt, normalization=fe_norm,
     )
     re_obj = GLMObjective(
         loss=loss, l2_weight=base_config.reg[re_cfg.coordinate_id].l2,
-        intercept_index=re_icpt,
+        intercept_index=re_icpt, normalization=re_norm,
     )
+    fe_folded = fe_norm is not None and not fe_norm.is_identity
+    re_folded = re_norm is not None and not re_norm.is_identity
     fe_spec_cfg = dataclasses.replace(
         fe_cfg.optimizer_spec().config(), track_history=False
     )
@@ -160,32 +181,55 @@ def build_batched_evaluator(
         def one(lf, lr):
             # The mini coordinate-descent loop of the production path
             # (CoordinateDescent → FE margin-LBFGS → per-block batched
-            # Newton), parameterized by this lane's traced λs.
+            # Newton), parameterized by this lane's traced λs. Carries live
+            # in MODEL space; solves convert in/out exactly like the
+            # production coordinates.
             w = jnp.zeros((d_fix,), jnp.float32)
             coefs = jnp.zeros((E, d_re), jnp.float32)
             for _ in range(num_iterations):
                 re_sc = re_scores_of(coefs, train_re_feats, train_eids)
+                w_start = (
+                    fe_norm.model_to_transformed_space(w) if fe_folded else w
+                )
                 fe_res = minimize_lbfgs_margin(
-                    fe_obj, train_lb.add_scores_to_offsets(re_sc), w,
+                    fe_obj, train_lb.add_scores_to_offsets(re_sc), w_start,
                     fe_spec_cfg, l2_override=lf,
                 )
-                w = fe_res.w
+                w = (
+                    fe_norm.transformed_to_model_space(fe_res.w)
+                    if fe_folded else fe_res.w
+                )
                 fe_scores = train_lb.margins(w)  # includes base offsets
                 for block in ds.blocks:
                     offs = block.gather_offsets(fe_scores)
                     w0 = coefs[block.entity_idx]
+                    # Same static routing predicate as the production
+                    # _solve_block (ADVICE r3: an explicit NEWTON spec past
+                    # the auto-dim cap must not score with a different
+                    # solver than the final refit).
+                    use_newton = newton_eligible(
+                        re_obj, re_cfg.optimizer_spec(), block.dim,
+                        has_mask=False,
+                    )
 
                     def solve_one(feat, lab, wt, off, wi):
                         lb = LabeledBatch(lab, feat, off, wt)
-                        if block.dim <= NEWTON_AUTO_MAX_DIM:
+                        wi_t = (
+                            re_norm.model_to_transformed_space(wi)
+                            if re_folded else wi
+                        )
+                        if use_newton:
                             res = minimize_newton(
-                                re_obj, lb, wi, re_spec_cfg, l2_override=lr
+                                re_obj, lb, wi_t, re_spec_cfg, l2_override=lr
                             )
                         else:
                             res = minimize_lbfgs_margin(
-                                re_obj, lb, wi, re_spec_cfg, l2_override=lr
+                                re_obj, lb, wi_t, re_spec_cfg, l2_override=lr
                             )
-                        return res.w
+                        return (
+                            re_norm.transformed_to_model_space(res.w)
+                            if re_folded else res.w
+                        )
 
                     w_new = jax.vmap(solve_one)(
                         block.features, block.label, block.weight, offs, w0
