@@ -155,12 +155,30 @@ class CoordinateDescent:
         if checkpoint_dir is not None:
             if checkpoint_every < 1:
                 raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
-            from photon_tpu.utils.checkpoint import latest_step, load_checkpoint
+            from photon_tpu.utils.checkpoint import (
+                LegacyCheckpointError,
+                latest_step,
+                load_checkpoint,
+            )
 
             tag = checkpoint_tag or ",".join(self.update_sequence)
             step = latest_step(checkpoint_dir)
             if step is not None:
-                state, _ = load_checkpoint(checkpoint_dir, step)
+                try:
+                    state, _ = load_checkpoint(checkpoint_dir, step)
+                except LegacyCheckpointError as exc:
+                    # A v1 (pickle) checkpoint written by an older version:
+                    # an upgrade must not turn a resumable job into a crash
+                    # loop — restart the sweep from step 0 (ADVICE r3).
+                    # Corrupt v2 checkpoints still raise (they are NOT
+                    # silently discarded).
+                    logger.warning(
+                        "ignoring unreadable legacy checkpoint at %s (%s); "
+                        "restarting training from step 0",
+                        checkpoint_dir, exc,
+                    )
+                    step = None
+            if step is not None:
                 if state.get("tag") != tag:
                     raise ValueError(
                         f"checkpoint at {checkpoint_dir} was written for a "

@@ -45,6 +45,7 @@ class ColumnarRows:
 
     n: int
     numeric: Dict[str, np.ndarray]  # field -> float64, NaN where null
+    longs: Dict[str, np.ndarray]  # long fields -> exact int64 (ids > 2^53)
     strings: Dict[str, np.ndarray]  # field -> int32 intern ids, -1 null
     bags: Dict[str, FeatureBagColumn]
     meta_rows: np.ndarray  # (m,) int32 record index
@@ -105,6 +106,7 @@ def _load_lib():
     for name, res in [
         ("avro_dec_num_records", ctypes.c_int64),
         ("avro_dec_numeric", ctypes.POINTER(ctypes.c_double)),
+        ("avro_dec_longcol", ctypes.POINTER(ctypes.c_int64)),
         ("avro_dec_strcol", ctypes.POINTER(ctypes.c_int32)),
         ("avro_dec_bag_len", ctypes.c_int64),
         ("avro_dec_bag_offsets", ctypes.POINTER(ctypes.c_int64)),
@@ -123,9 +125,9 @@ def _load_lib():
         fn.restype = res
         fn.argtypes = (
             [ctypes.c_void_p, ctypes.c_int]
-            if name in ("avro_dec_numeric", "avro_dec_strcol", "avro_dec_bag_len",
-                        "avro_dec_bag_offsets", "avro_dec_bag_keys",
-                        "avro_dec_bag_values")
+            if name in ("avro_dec_numeric", "avro_dec_longcol", "avro_dec_strcol",
+                        "avro_dec_bag_len", "avro_dec_bag_offsets",
+                        "avro_dec_bag_keys", "avro_dec_bag_values")
             else [ctypes.c_void_p]
         )
     lib.avro_dec_free.argtypes = [ctypes.c_void_p]
@@ -261,12 +263,15 @@ def read_avro_columnar(paths: Sequence[str]) -> Optional[ColumnarRows]:
             return np.ctypeslib.as_array(ptr, shape=(count,)).astype(dtype, copy=True)
 
         numeric: Dict[str, np.ndarray] = {}
+        longs: Dict[str, np.ndarray] = {}
         strings: Dict[str, np.ndarray] = {}
         bags: Dict[str, FeatureBagColumn] = {}
         for i, op in enumerate(program):
             fname = names[i]
             if op in (_OP_DOUBLE, _OP_OPT_DOUBLE, _OP_FLOAT, _OP_LONG):
                 numeric[fname] = arr(lib.avro_dec_numeric(ctx, i), n, np.float64)
+                if op == _OP_LONG:
+                    longs[fname] = arr(lib.avro_dec_longcol(ctx, i), n, np.int64)
             elif op in (_OP_STR, _OP_OPT_STR):
                 strings[fname] = arr(lib.avro_dec_strcol(ctx, i), n, np.int32)
             elif op == _OP_BAG:
@@ -289,7 +294,7 @@ def read_avro_columnar(paths: Sequence[str]) -> Optional[ColumnarRows]:
             blob[offs[i]:offs[i + 1]].decode("utf-8") for i in range(n_intern)
         ]
         return ColumnarRows(
-            n=n, numeric=numeric, strings=strings, bags=bags,
+            n=n, numeric=numeric, longs=longs, strings=strings, bags=bags,
             meta_rows=meta_rows, meta_keys=meta_keys, meta_vals=meta_vals,
             intern=intern,
         )

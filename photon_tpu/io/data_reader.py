@@ -33,6 +33,20 @@ INTERCEPT_KEY = IndexMap.INTERCEPT
 RESPONSE, OFFSET, WEIGHT, UID, META = "response", "offset", "weight", "uid", "metadataMap"
 
 
+@dataclasses.dataclass(frozen=True)
+class InputColumnsNames:
+    """Reserved-column indirection (reference InputColumnsNames,
+    photon-api data/InputColumnsNames.scala): lets input files use custom
+    names for the reserved columns (the reference's
+    different-column-names fixture exercises exactly this)."""
+
+    response: str = RESPONSE
+    offset: str = OFFSET
+    weight: str = WEIGHT
+    uid: str = UID
+    metadata: str = META
+
+
 @dataclasses.dataclass
 class FeatureShardConfig:
     """Bags merged into one shard + intercept flag (reference
@@ -66,10 +80,27 @@ def read_avro_rows(paths: Sequence[str]) -> List[dict]:
     return rows
 
 
-def _row_label(row: dict) -> float:
-    if "label" in row:
-        return float(row["label"])
-    return float(row.get("response", 0.0))
+def _row_get(row: dict, names: Sequence[str]):
+    """First present (non-None) value among candidate column names."""
+    for name in names:
+        v = row.get(name)
+        if v is not None:
+            return v
+    return None
+
+
+def _label_columns(response_col: str) -> Tuple[str, ...]:
+    """Label resolution order. With default column names this preserves the
+    historical label-then-response precedence (rows carrying BOTH train on
+    'label'); a custom response column always wins."""
+    if response_col != RESPONSE:
+        return (response_col, "label", RESPONSE)
+    return ("label", RESPONSE)
+
+
+def _row_label(row: dict, response_col: str = RESPONSE) -> float:
+    v = _row_get(row, _label_columns(response_col))
+    return float(v) if v is not None else 0.0
 
 
 def build_index_maps(
@@ -96,6 +127,7 @@ def rows_to_game_batch(
     entity_id_columns: Optional[Dict[str, str]] = None,  # RE type -> id column
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
     intern_new_entities: bool = True,
+    column_names: Optional[InputColumnsNames] = None,
 ) -> Tuple[GameBatch, Dict[str, EntityIndex]]:
     """Merge feature bags per shard, inject intercepts, intern entity ids.
 
@@ -105,11 +137,17 @@ def rows_to_game_batch(
     n = len(rows)
     entity_id_columns = entity_id_columns or {}
     entity_indexes = entity_indexes or {}
+    cn = column_names or InputColumnsNames()
 
-    label = np.array([_row_label(r) for r in rows], np.float32)
-    offset = np.array([float(r.get("offset") or 0.0) for r in rows], np.float32)
+    label = np.array([_row_label(r, cn.response) for r in rows], np.float32)
+    offset = np.array(
+        [float(_row_get(r, (cn.offset, OFFSET)) or 0.0) for r in rows], np.float32
+    )
     weight = np.array(
-        [float(r["weight"]) if r.get("weight") is not None else 1.0 for r in rows],
+        [
+            float(v) if (v := _row_get(r, (cn.weight, WEIGHT))) is not None else 1.0
+            for r in rows
+        ],
         np.float32,
     )
     uid = np.arange(n, dtype=np.int64)
@@ -148,7 +186,7 @@ def rows_to_game_batch(
         eidx = entity_indexes.setdefault(re_type, EntityIndex())
         ids = np.empty(n, np.int32)
         for i, row in enumerate(rows):
-            meta = row.get(META) or {}
+            meta = _row_get(row, (cn.metadata, META)) or {}
             raw = meta.get(col, row.get(col))
             if raw is None:
                 ids[i] = -1
@@ -195,24 +233,32 @@ def _columnar_to_game_batch(
     entity_id_columns: Optional[Dict[str, str]] = None,
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
     intern_new_entities: bool = True,
+    column_names: Optional[InputColumnsNames] = None,
 ) -> Tuple[GameBatch, Dict[str, EntityIndex]]:
     """Vectorized rows_to_game_batch over native-decoded columns: one
     IndexMap lookup per DISTINCT key, numpy scatters for the matrices."""
     n = cols.n
     entity_id_columns = entity_id_columns or {}
     entity_indexes = entity_indexes or {}
+    cn = column_names or InputColumnsNames()
 
-    label_col = cols.numeric.get("label", cols.numeric.get("response"))
+    def _num_col(names):
+        for name in names:
+            if name in cols.numeric:
+                return cols.numeric[name]
+        return None
+
+    label_col = _num_col(_label_columns(cn.response))
     label = np.nan_to_num(
         np.zeros(n, np.float64) if label_col is None else label_col, nan=0.0
     ).astype(np.float32)
-    off_col = cols.numeric.get("offset")
+    off_col = _num_col((cn.offset, OFFSET))
     offset = (
         np.zeros(n, np.float32)
         if off_col is None
         else np.nan_to_num(off_col, nan=0.0).astype(np.float32)
     )
-    wt_col = cols.numeric.get("weight")
+    wt_col = _num_col((cn.weight, WEIGHT))
     weight = (
         np.ones(n, np.float32)
         if wt_col is None
@@ -297,6 +343,34 @@ def _columnar_to_game_batch(
             lut[iid] = eidx.intern(s) if intern_new_entities else eidx.lookup(s)
         sel = raw >= 0
         ids[sel] = lut[raw[sel]]
+        if col in cols.numeric:
+            # Numeric (long/int) top-level id fields: the row path (and the
+            # reference, GameConvertersIntegTest's Long id columns) interns
+            # str(raw), so format integral values as integer strings. One
+            # intern per DISTINCT value, vectorized scatter for the rest.
+            # Long columns use the exact int64 store (doubles would collapse
+            # distinct ids past 2^53).
+            num = cols.longs.get(col, cols.numeric[col])
+            exact = col in cols.longs
+            fill = (ids < 0) & (
+                np.ones(n, bool) if exact else np.isfinite(num)
+            )
+            if fill.any():
+                uniq, inv = np.unique(num[fill], return_inverse=True)
+                # Match the row path's str(raw) exactly: long columns decode
+                # to python ints ("123"), double columns to floats ("123.0").
+                mapped = np.fromiter(
+                    (
+                        eidx.intern(s) if intern_new_entities else eidx.lookup(s)
+                        for s in (
+                            str(int(v)) if exact else str(float(v))
+                            for v in uniq
+                        )
+                    ),
+                    np.int32,
+                    count=len(uniq),
+                )
+                ids[fill] = mapped[inv]
         entity_ids[re_type] = ids
 
     batch = GameBatch(
@@ -318,6 +392,7 @@ def read_merged(
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
     intern_new_entities: bool = True,
     use_columnar: bool = True,
+    column_names: Optional[InputColumnsNames] = None,
 ) -> Tuple[GameBatch, Dict[str, IndexMap], Dict[str, EntityIndex]]:
     """DataReader.readMerged role: read Avro files → GameBatch (+ created
     index maps when not supplied). Prefers the native columnar decode path
@@ -334,7 +409,7 @@ def read_merged(
                 index_maps = _columnar_index_maps(cols, shard_configs)
             batch, entity_indexes = _columnar_to_game_batch(
                 cols, shard_configs, index_maps, entity_id_columns,
-                entity_indexes, intern_new_entities,
+                entity_indexes, intern_new_entities, column_names,
             )
             return batch, index_maps, entity_indexes
     rows = read_avro_rows(paths)
@@ -342,6 +417,6 @@ def read_merged(
         index_maps = build_index_maps(rows, shard_configs)
     batch, entity_indexes = rows_to_game_batch(
         rows, shard_configs, index_maps, entity_id_columns, entity_indexes,
-        intern_new_entities,
+        intern_new_entities, column_names,
     )
     return batch, index_maps, entity_indexes

@@ -133,6 +133,9 @@ struct Ctx {
   int64_t n_records = 0;
   // Per-field outputs, indexed by field position (empty where unused).
   std::vector<std::vector<double>> numeric;
+  // Exact int64 values for long fields (op 8) — doubles lose precision
+  // past 2^53, which would corrupt 64-bit entity ids.
+  std::vector<std::vector<int64_t>> longcol;
   std::vector<std::vector<int32_t>> strcol;
   std::vector<std::vector<int64_t>> bag_offsets;  // CSR, length n+1 per bag
   std::vector<std::vector<int32_t>> bag_keys;
@@ -151,6 +154,10 @@ bool decode_record(Ctx* c, Reader& r) {
         break;
       case 1: {  // union [null, double]
         int64_t tag = r.read_long();
+        // A tag outside {0,1} means corrupt or schema-evolved input; treating
+        // it as null would desync the stream — fail so the caller falls back
+        // to the pure-Python codec.
+        if (tag != 0 && tag != 1) return false;
         c->numeric[fi].push_back(tag == 1 ? r.read_double()
                                           : std::nan(""));
         break;
@@ -163,6 +170,7 @@ bool decode_record(Ctx* c, Reader& r) {
       }
       case 3: {  // union [null, string]
         int64_t tag = r.read_long();
+        if (tag != 0 && tag != 1) return false;
         if (tag == 1) {
           const char* s; int64_t n;
           if (!r.read_str(&s, &n)) return false;
@@ -196,6 +204,7 @@ bool decode_record(Ctx* c, Reader& r) {
       }
       case 5: {  // union [null, map<string>]
         int64_t tag = r.read_long();
+        if (tag != 0 && tag != 1) return false;
         if (tag != 1) break;
         [[fallthrough]];
       }
@@ -222,9 +231,12 @@ bool decode_record(Ctx* c, Reader& r) {
       case 7:  // float
         c->numeric[fi].push_back(static_cast<double>(r.read_float()));
         break;
-      case 8:  // int/long
-        c->numeric[fi].push_back(static_cast<double>(r.read_long()));
+      case 8: {  // int/long
+        int64_t v = r.read_long();
+        c->numeric[fi].push_back(static_cast<double>(v));
+        c->longcol[fi].push_back(v);
         break;
+      }
       default:
         return false;
     }
@@ -242,6 +254,7 @@ Ctx* avro_dec_new(const uint8_t* program, int n_fields) {
   Ctx* c = new Ctx();
   c->program.assign(program, program + n_fields);
   c->numeric.resize(n_fields);
+  c->longcol.resize(n_fields);
   c->strcol.resize(n_fields);
   c->bag_offsets.resize(n_fields);
   c->bag_keys.resize(n_fields);
@@ -265,6 +278,7 @@ int avro_dec_block(Ctx* c, const uint8_t* data, int64_t size, int64_t count) {
 int64_t avro_dec_num_records(Ctx* c) { return c->n_records; }
 
 const double* avro_dec_numeric(Ctx* c, int fi) { return c->numeric[fi].data(); }
+const int64_t* avro_dec_longcol(Ctx* c, int fi) { return c->longcol[fi].data(); }
 const int32_t* avro_dec_strcol(Ctx* c, int fi) { return c->strcol[fi].data(); }
 
 int64_t avro_dec_bag_len(Ctx* c, int fi) {

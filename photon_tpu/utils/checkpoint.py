@@ -40,6 +40,12 @@ import numpy as np
 _LATEST = "LATEST"
 _FORMAT_VERSION = 2
 
+
+class LegacyCheckpointError(ValueError):
+    """Raised for v1 (pickle-era) checkpoints. Typed so resume sites can
+    restart-from-scratch on upgrades without string-matching messages
+    (which would misclassify genuinely corrupt v2 checkpoints)."""
+
 # ---------------------------------------------------------------------------
 # Registry: stable key ↔ class. Keys are the durable identity — keep them
 # unchanged across refactors/renames.
@@ -253,9 +259,19 @@ def load_checkpoint(directory: str, step: Optional[int] = None) -> Tuple[Any, in
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
     # allow_pickle stays False (numpy default): object arrays are rejected.
-    with np.load(os.path.join(directory, f"step_{step}.npz")) as z:
+    path = os.path.join(directory, f"step_{step}.npz")
+    try:
+        z_ctx = np.load(path)
+    except (ValueError, OSError) as exc:
+        if "pickle" in str(exc):  # a v1 pickle file, not an npz at all
+            raise LegacyCheckpointError(
+                f"legacy (pickle-based) checkpoint at {path} — not loadable "
+                "by this version; retrain or re-save"
+            ) from exc
+        raise
+    with z_ctx as z:
         if "__manifest__" not in z:
-            raise ValueError(
+            raise LegacyCheckpointError(
                 "legacy (pickle-based) checkpoint format — not loadable by "
                 "this version; retrain or re-save"
             )

@@ -254,3 +254,28 @@ def test_checkpoint_shape_validation(tmp_path):
     np.savez(path, **data)
     with pytest.raises(ValueError, match="manifest"):
         ckpt.load_checkpoint(str(tmp_path), 0)
+
+
+def test_cd_legacy_checkpoint_restarts_instead_of_crashing(tmp_path, caplog):
+    """A v1 (pickle-era) checkpoint must not crash-loop a resumed job: the
+    descent logs a warning and restarts from step 0 (ADVICE r3)."""
+    import logging
+
+    batch, coords = _glmix_setup()
+    seq = ["global", "per_user"]
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    # Fake a legacy checkpoint: an npz without the v2 __manifest__ entry.
+    np.savez(ck / "step_0.npz", models=np.zeros(3))
+    (ck / "LATEST").write_text("0")
+    with caplog.at_level(logging.WARNING):
+        result = CoordinateDescent(dict(coords), seq, num_iterations=1).run(
+            batch, checkpoint_dir=str(ck)
+        )
+    assert result.model is not None
+    assert any("legacy" in r.message for r in caplog.records)
+    # The restart overwrote the legacy file with a loadable v2 checkpoint.
+    from photon_tpu.utils.checkpoint import load_checkpoint
+
+    state, step = load_checkpoint(str(ck))
+    assert step == 0 and state["tag"] == "global,per_user"

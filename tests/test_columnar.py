@@ -167,3 +167,124 @@ def test_columnar_is_faster(tmp_path):
     read_merged([str(path)], cfg, use_columnar=False)
     t_slow = time.perf_counter() - t0
     assert t_fast < t_slow, (t_fast, t_slow)
+
+
+@native_available
+def test_numeric_entity_id_column_parity(tmp_path):
+    """A long top-level id field must yield the same interned entity ids on
+    both paths (ADVICE r3 high: the columnar lane used to consult only
+    metadataMap/string columns, silently disabling random effects).
+    Reference covers Long id columns via toString (GameConvertersIntegTest)."""
+    from photon_tpu.io.schemas import FEATURE_SCHEMA
+
+    schema = {
+        "type": "record",
+        "name": "LongIdRow",
+        "fields": [
+            {"name": "response", "type": "double"},
+            {"name": "userId", "type": "long"},
+            {"name": "features", "type": {"type": "array", "items": FEATURE_SCHEMA}},
+        ],
+    }
+    path = tmp_path / "longid.avro"
+    records = [
+        {
+            "response": float(i % 2),
+            "userId": int(i % 7) * 1000,
+            "features": [{"name": f"f{i % 5}", "term": "", "value": 1.0 + i}],
+        }
+        for i in range(60)
+    ]
+    write_avro_records(str(path), schema, records)
+    assert read_avro_columnar([str(path)]) is not None  # fast lane taken
+    cfg = {"s": FeatureShardConfig(feature_bags=["features"])}
+    ids = {"userId": "userId"}
+    b_fast, _, eidx_fast = read_merged([str(path)], cfg, entity_id_columns=ids)
+    b_slow, _, eidx_slow = read_merged(
+        [str(path)], cfg, entity_id_columns=ids, use_columnar=False
+    )
+    fast = np.asarray(b_fast.entity_ids["userId"])
+    slow = np.asarray(b_slow.entity_ids["userId"])
+    assert (fast >= 0).all()  # the bug made these all -1
+    np.testing.assert_array_equal(fast, slow)
+    assert eidx_fast["userId"].ids() == eidx_slow["userId"].ids()
+
+
+@native_available
+def test_long_entity_ids_beyond_double_precision(tmp_path):
+    """64-bit entity ids above 2^53 must not collapse through the columnar
+    lane (longs ride an exact int64 store, not the float64 numeric column)."""
+    from photon_tpu.io.schemas import FEATURE_SCHEMA
+
+    schema = {
+        "type": "record",
+        "name": "HugeIdRow",
+        "fields": [
+            {"name": "response", "type": "double"},
+            {"name": "userId", "type": "long"},
+            {"name": "features", "type": {"type": "array", "items": FEATURE_SCHEMA}},
+        ],
+    }
+    base = (1 << 53) + 1  # adjacent ids indistinguishable in float64
+    records = [
+        {
+            "response": float(i % 2),
+            "userId": base + (i % 4),
+            "features": [{"name": "a", "term": "", "value": 1.0}],
+        }
+        for i in range(40)
+    ]
+    path = tmp_path / "huge.avro"
+    write_avro_records(str(path), schema, records)
+    cfg = {"s": FeatureShardConfig(feature_bags=["features"])}
+    ids = {"userId": "userId"}
+    fast, _, eidx_fast = read_merged([str(path)], cfg, entity_id_columns=ids)
+    slow, _, eidx_slow = read_merged(
+        [str(path)], cfg, entity_id_columns=ids, use_columnar=False
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fast.entity_ids["userId"]), np.asarray(slow.entity_ids["userId"])
+    )
+    # 4 DISTINCT entities, interned by exact string
+    assert len(set(np.asarray(fast.entity_ids["userId"]).tolist())) == 4
+    assert eidx_fast["userId"].ids() == eidx_slow["userId"].ids()
+    assert str(base) in eidx_fast["userId"].ids()
+
+
+@native_available
+def test_double_entity_id_column_parity(tmp_path):
+    """A double-typed id column must intern the SAME strings on both lanes
+    (row path interns str(float) like '123.0'; the columnar lane must not
+    shorten integral doubles to '123')."""
+    from photon_tpu.io.schemas import FEATURE_SCHEMA
+
+    schema = {
+        "type": "record",
+        "name": "DoubleIdRow",
+        "fields": [
+            {"name": "response", "type": "double"},
+            {"name": "userId", "type": "double"},
+            {"name": "features", "type": {"type": "array", "items": FEATURE_SCHEMA}},
+        ],
+    }
+    path = tmp_path / "dblid.avro"
+    records = [
+        {
+            "response": float(i % 2),
+            "userId": float(i % 5),  # integral doubles: str() gives '3.0'
+            "features": [{"name": "a", "term": "", "value": 1.0}],
+        }
+        for i in range(30)
+    ]
+    write_avro_records(str(path), schema, records)
+    cfg = {"s": FeatureShardConfig(feature_bags=["features"])}
+    ids = {"userId": "userId"}
+    fast, _, eidx_fast = read_merged([str(path)], cfg, entity_id_columns=ids)
+    slow, _, eidx_slow = read_merged(
+        [str(path)], cfg, entity_id_columns=ids, use_columnar=False
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fast.entity_ids["userId"]), np.asarray(slow.entity_ids["userId"])
+    )
+    assert eidx_fast["userId"].ids() == eidx_slow["userId"].ids()
+    assert "3.0" in eidx_fast["userId"].ids()
