@@ -116,6 +116,12 @@ def save_game_model(
         if isinstance(sub, FixedEffectModel):
             cdir = os.path.join(output_dir, FIXED_DIR, cid, COEFF_DIR)
             os.makedirs(cdir, exist_ok=True)
+            # Reference layout: fixed-effect/<name>/id-info holds the feature
+            # shard id (ModelProcessingUtils.scala:99,173).
+            with open(
+                os.path.join(output_dir, FIXED_DIR, cid, ID_INFO_FILE), "w"
+            ) as f:
+                f.write(sub.feature_shard + "\n")
             imap = index_maps[sub.feature_shard]
             rec = _coeffs_to_avro(
                 cid,
@@ -140,9 +146,12 @@ def save_game_model(
             }
         elif isinstance(sub, RandomEffectModel):
             cdir = os.path.join(output_dir, RANDOM_DIR, cid)
-            os.makedirs(cdir, exist_ok=True)
+            os.makedirs(os.path.join(cdir, COEFF_DIR), exist_ok=True)
+            # Reference layout: random-effect/<name>/id-info holds TWO lines,
+            # (randomEffectType, featureShardId)
+            # (ModelProcessingUtils.scala:116,216).
             with open(os.path.join(cdir, ID_INFO_FILE), "w") as f:
-                f.write(sub.re_type)
+                f.write(sub.re_type + "\n" + sub.feature_shard + "\n")
             imap = index_maps[sub.feature_shard]
             eidx = entity_indexes.get(sub.re_type)
             coefs = np.asarray(sub.coefficients)
@@ -161,7 +170,7 @@ def save_game_model(
                     )
                 )
             write_avro_records(
-                os.path.join(cdir, "part-00000.avro"),
+                os.path.join(cdir, COEFF_DIR, "part-00000.avro"),
                 BAYESIAN_LINEAR_MODEL_SCHEMA,
                 records,
             )
@@ -179,9 +188,9 @@ def save_game_model(
             # never materialized (ModelProjection.projectBackward role,
             # performed per nonzero coefficient at write time).
             cdir = os.path.join(output_dir, RANDOM_DIR, cid)
-            os.makedirs(cdir, exist_ok=True)
+            os.makedirs(os.path.join(cdir, COEFF_DIR), exist_ok=True)
             with open(os.path.join(cdir, ID_INFO_FILE), "w") as f:
-                f.write(sub.re_type)
+                f.write(sub.re_type + "\n" + sub.feature_shard + "\n")
             imap = index_maps[sub.feature_shard]
             eidx = entity_indexes.get(sub.re_type)
             entity_block = np.asarray(sub.entity_block)
@@ -220,7 +229,7 @@ def save_game_model(
                     }
                 )
             write_avro_records(
-                os.path.join(cdir, "part-00000.avro"),
+                os.path.join(cdir, COEFF_DIR, "part-00000.avro"),
                 BAYESIAN_LINEAR_MODEL_SCHEMA,
                 records,
             )
@@ -235,8 +244,54 @@ def save_game_model(
         else:
             raise TypeError(f"unknown submodel type {type(sub)}")
 
+    tasks = [c["task"] for c in meta["coordinates"].values()]
+    if tasks:
+        meta.setdefault("modelType", tasks[0])  # reference metadata key
     with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
         json.dump(meta, f, indent=2)
+
+
+def _scan_model_dir(model_dir: str, meta: dict) -> Dict[str, dict]:
+    """Reconstruct per-coordinate info by scanning a reference-written model
+    directory (the reference stores NO coordinate table in its metadata —
+    loadGameModelFromHDFS lists fixed-effect/ and random-effect/ and reads
+    each coordinate's id-info, ModelProcessingUtils.scala:160-220)."""
+    task = meta.get("modelType", TaskType.LOGISTIC_REGRESSION.value)
+    coords: Dict[str, dict] = {}
+    fdir = os.path.join(model_dir, FIXED_DIR)
+    if os.path.isdir(fdir):
+        for cid in sorted(os.listdir(fdir)):
+            with open(os.path.join(fdir, cid, ID_INFO_FILE)) as f:
+                (shard,) = f.read().split()
+            coords[cid] = {"type": "fixed", "featureShard": shard, "task": task}
+    rdir = os.path.join(model_dir, RANDOM_DIR)
+    if os.path.isdir(rdir):
+        for cid in sorted(os.listdir(rdir)):
+            with open(os.path.join(rdir, cid, ID_INFO_FILE)) as f:
+                re_type, shard = f.read().split()
+            coords[cid] = {
+                "type": "random", "reType": re_type, "featureShard": shard,
+                "task": task,
+            }
+    return coords
+
+
+def _coefficient_files(cdir: str) -> list:
+    """Coefficient part files for one coordinate: the reference layout puts
+    them under <coordinate>/coefficients/part-*.avro; rounds ≤3 of this repo
+    wrote RE parts directly in <coordinate>/."""
+    out = []
+    coeff_dir = os.path.join(cdir, COEFF_DIR)
+    for d in (coeff_dir, cdir):
+        if os.path.isdir(d):
+            out = [
+                os.path.join(d, fn)
+                for fn in sorted(os.listdir(d))
+                if fn.endswith(".avro")
+            ]
+            if out:
+                return out
+    return out
 
 
 def load_game_model(
@@ -246,21 +301,40 @@ def load_game_model(
 ) -> GameModel:
     """loadGameModelFromHDFS role (ModelProcessingUtils.scala:143+). Entity
     ids are re-interned against the provided EntityIndex (or a fresh one),
-    so warm starts align with the new run's interning."""
+    so warm starts align with the new run's interning. Reads both this
+    repo's metadata-driven layout and reference-written directories
+    (directory scan + id-info, proven against the reference's checked-in
+    GameIntegTest fixtures)."""
     entity_indexes = entity_indexes if entity_indexes is not None else {}
-    with open(os.path.join(model_dir, METADATA_FILE)) as f:
-        meta = json.load(f)
+    meta = {}
+    meta_path = os.path.join(model_dir, METADATA_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    coordinates = meta.get("coordinates") or _scan_model_dir(model_dir, meta)
+    if not coordinates:
+        raise FileNotFoundError(
+            f"no GAME model at {model_dir!r}: neither a metadata coordinate "
+            "table nor fixed-effect/ / random-effect/ directories found"
+        )
 
     models: Dict[str, object] = {}
-    for cid, info in meta["coordinates"].items():
+    for cid, info in coordinates.items():
         task = TaskType(info["task"])
         shard = info["featureShard"]
         imap = index_maps[shard]
         dim = info.get("dim", len(imap))
         if info["type"] == "fixed":
-            path = os.path.join(model_dir, FIXED_DIR, cid, COEFF_DIR, "part-00000.avro")
-            (rec,) = read_avro_records(path)
-            means, variances, _ = _avro_to_coeffs(rec, imap, dim)
+            cdir = os.path.join(model_dir, FIXED_DIR, cid)
+            recs = []
+            for path in _coefficient_files(cdir):
+                recs.extend(read_avro_records(path))
+            if len(recs) != 1:  # Spark may write empty extra part files
+                raise ValueError(
+                    f"fixed-effect coordinate {cid!r}: expected exactly one "
+                    f"coefficient record across part files, got {len(recs)}"
+                )
+            means, variances, _ = _avro_to_coeffs(recs[0], imap, dim)
             models[cid] = FixedEffectModel(
                 GeneralizedLinearModel(
                     Coefficients(
@@ -274,12 +348,11 @@ def load_game_model(
         else:
             cdir = os.path.join(model_dir, RANDOM_DIR, cid)
             with open(os.path.join(cdir, ID_INFO_FILE)) as f:
-                re_type = f.read().strip()
+                re_type = f.read().split()[0]
             eidx = entity_indexes.setdefault(re_type, EntityIndex())
             recs = []
-            for fn in sorted(os.listdir(cdir)):
-                if fn.endswith(".avro"):
-                    recs.extend(read_avro_records(os.path.join(cdir, fn)))
+            for path in _coefficient_files(cdir):
+                recs.extend(read_avro_records(path))
             # First pass: intern all entity ids.
             for rec in recs:
                 eidx.intern(rec["modelId"])
