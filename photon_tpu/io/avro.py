@@ -388,7 +388,8 @@ def read_avro_records(path: str) -> List[Any]:
 
 
 def write_avro_records(path: str, schema: Schema, records: Iterable[Any],
-                       codec: str = "deflate") -> None:
-    with AvroWriter(path, schema, codec) as w:
+                       codec: str = "deflate",
+                       block_records: int = 4096) -> None:
+    with AvroWriter(path, schema, codec, block_records) as w:
         for rec in records:
             w.append(rec)

@@ -199,104 +199,222 @@ def compile_program(schema) -> Optional[Tuple[bytes, List[str]]]:
     return bytes(ops), names
 
 
-def _iter_blocks(path: str):
-    """Yield (count, decompressed bytes) per container block + the schema."""
-    with open(path, "rb") as f:
-        raw = f.read()
-    if raw[:4] != MAGIC:
+_HEADER_PROBE = 1 << 16  # initial read: magic + metadata map + sync
+
+
+def _read_header(f):
+    """Parse an object-container header from an open file. Returns
+    (schema, codec, sync, byte offset of the first block)."""
+    buf = f.read(_HEADER_PROBE)
+    if buf[:4] != MAGIC:
         raise ValueError("not an Avro object container file")
-    r = _Reader(raw)
-    r.pos = 4
-    meta = _Codec(_META_SCHEMA).decode(r)
+    while True:  # metadata map can exceed the probe; grow geometrically
+        try:
+            r = _Reader(buf)
+            r.pos = 4
+            meta = _Codec(_META_SCHEMA).decode(r)
+            sync = r.read_fixed(SYNC_SIZE)
+            if len(sync) != SYNC_SIZE:  # silently-short slice = truncated
+                raise IndexError("truncated header")
+            break
+        except (IndexError, ValueError):
+            more = f.read(len(buf))
+            if not more:
+                raise
+            buf += more
+    f.seek(r.pos)  # rewind to the first block (probe over-read)
     import json
 
     schema = json.loads(meta["avro.schema"].decode())
     codec = meta.get("avro.codec", b"null").decode()
     if codec not in ("null", "deflate"):
         raise ValueError(f"unsupported avro codec {codec}")
-    sync = r.read_fixed(SYNC_SIZE)
-    blocks = []
-    n_total = len(r.buf)
-    while r.pos < n_total:
-        count = r.read_long()
-        size = r.read_long()
-        data = r.read_fixed(size)
-        if codec == "deflate":
-            data = zlib.decompress(data, -15)
-        if r.read_fixed(SYNC_SIZE) != sync:
-            raise ValueError("bad sync marker (corrupt file)")
-        blocks.append((count, data))
-    return schema, blocks
+    return schema, codec, sync, r.pos
 
 
-def read_avro_columnar(paths: Sequence[str]) -> Optional[ColumnarRows]:
-    """Decode container files into columns via the native decoder.
-    Returns None when the native path is unavailable or the schema is
-    outside the supported program (callers fall back to rows)."""
-    lib = _load_lib()
-    if lib is None:
+def _read_block_varint(f) -> Optional[int]:
+    """Read one zigzag varint directly from a file (None at clean EOF)."""
+    shift = 0
+    acc = 0
+    first = f.read(1)
+    if not first:
         return None
-    file_blocks = []
+    b = first[0]
+    while True:
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        nxt = f.read(1)
+        if not nxt:
+            raise ValueError("truncated varint in container block header")
+        b = nxt[0]
+    return (acc >> 1) ^ -(acc & 1)
+
+
+def stream_blocks(path: str):
+    """(schema, generator of (count, decompressed bytes)) — reads the file
+    incrementally so host memory stays bounded by ONE block, not the file
+    (the round-3 reader slurped the whole container and materialized every
+    decompressed block; reference streams per-partition,
+    AvroDataReader.scala:165-209).
+
+    The header parse opens/closes the file immediately; the generator
+    reopens it lazily on first consumption — an UNSTARTED generator holds
+    no file descriptor, so compiling schemas for thousands of paths never
+    exhausts the FD limit."""
+    with open(path, "rb") as f:
+        schema, codec, sync, _pos = _read_header(f)
+        start = f.tell()
+
+    def gen():
+        with open(path, "rb") as f:
+            f.seek(start)
+            while True:
+                count = _read_block_varint(f)
+                if count is None:
+                    return
+                size = _read_block_varint(f)
+                data = f.read(size)
+                if len(data) != size:
+                    raise ValueError("truncated container block")
+                block = (
+                    zlib.decompress(data, -15) if codec == "deflate" else data
+                )
+                if f.read(SYNC_SIZE) != sync:
+                    raise ValueError("bad sync marker (corrupt file)")
+                yield count, block
+
+    return schema, gen()
+
+
+def _extract_columns(lib, ctx, program, names) -> ColumnarRows:
+    """Copy a decode context's accumulated columns out into numpy arrays."""
+    n = int(lib.avro_dec_num_records(ctx))
+
+    def arr(ptr, count, dtype):
+        if count == 0:
+            return np.empty(0, dtype)
+        return np.ctypeslib.as_array(ptr, shape=(count,)).astype(dtype, copy=True)
+
+    numeric: Dict[str, np.ndarray] = {}
+    longs: Dict[str, np.ndarray] = {}
+    strings: Dict[str, np.ndarray] = {}
+    bags: Dict[str, FeatureBagColumn] = {}
+    for i, op in enumerate(program):
+        fname = names[i]
+        if op in (_OP_DOUBLE, _OP_OPT_DOUBLE, _OP_FLOAT, _OP_LONG):
+            numeric[fname] = arr(lib.avro_dec_numeric(ctx, i), n, np.float64)
+            if op == _OP_LONG:
+                longs[fname] = arr(lib.avro_dec_longcol(ctx, i), n, np.int64)
+        elif op in (_OP_STR, _OP_OPT_STR):
+            strings[fname] = arr(lib.avro_dec_strcol(ctx, i), n, np.int32)
+        elif op == _OP_BAG:
+            nnz = int(lib.avro_dec_bag_len(ctx, i))
+            bags[fname] = FeatureBagColumn(
+                offsets=arr(lib.avro_dec_bag_offsets(ctx, i), n + 1, np.int64),
+                key_ids=arr(lib.avro_dec_bag_keys(ctx, i), nnz, np.int32),
+                values=arr(lib.avro_dec_bag_values(ctx, i), nnz, np.float64),
+            )
+    m = int(lib.avro_dec_meta_len(ctx))
+    meta_rows = arr(lib.avro_dec_meta_rows(ctx), m, np.int32)
+    meta_keys = arr(lib.avro_dec_meta_keys(ctx), m, np.int32)
+    meta_vals = arr(lib.avro_dec_meta_vals(ctx), m, np.int32)
+
+    n_intern = int(lib.avro_dec_intern_count(ctx))
+    blob_len = int(lib.avro_dec_intern_blob_len(ctx))
+    blob = ctypes.string_at(lib.avro_dec_intern_blob(ctx), blob_len)
+    offs = arr(lib.avro_dec_intern_offsets(ctx), n_intern + 1, np.int64)
+    intern = [
+        blob[offs[i]:offs[i + 1]].decode("utf-8") for i in range(n_intern)
+    ]
+    return ColumnarRows(
+        n=n, numeric=numeric, longs=longs, strings=strings, bags=bags,
+        meta_rows=meta_rows, meta_keys=meta_keys, meta_vals=meta_vals,
+        intern=intern,
+    )
+
+
+def _compile_for_paths(paths: Sequence[str]):
+    """(program, names, list of per-path block generators) or None when any
+    schema falls outside the supported program / schemas differ."""
     program = names = None
+    gens = []
     for path in paths:
-        schema, blocks = _iter_blocks(path)
+        schema, gen = stream_blocks(path)
         compiled = compile_program(schema)
-        if compiled is None:
+        if compiled is None or (
+            program is not None
+            and (compiled[0] != program or compiled[1] != names)
+        ):
+            gen.close()
+            for g in gens:
+                g.close()
             return None
         if program is None:
             program, names = compiled
-        elif compiled[0] != program or compiled[1] != names:
-            return None  # heterogeneous schemas: keep it simple, fall back
-        file_blocks.extend(blocks)
+        gens.append(gen)
+    return program, names, gens
+
+
+def read_avro_columnar(paths: Sequence[str]) -> Optional[ColumnarRows]:
+    """Decode container files into columns via the native decoder. Blocks
+    stream through one at a time (bounded by a single decompressed block,
+    not the file). Returns None when the native path is unavailable or the
+    schema is outside the supported program (callers fall back to rows)."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    compiled = _compile_for_paths(paths)
+    if compiled is None:
+        return None
+    program, names, gens = compiled
 
     ctx = lib.avro_dec_new(program, len(program))
     try:
-        for count, data in file_blocks:
-            rc = lib.avro_dec_block(ctx, data, len(data), count)
-            if rc != 0:
-                return None  # malformed vs program: fall back to Python codec
-        n = int(lib.avro_dec_num_records(ctx))
-
-        def arr(ptr, count, dtype):
-            if count == 0:
-                return np.empty(0, dtype)
-            return np.ctypeslib.as_array(ptr, shape=(count,)).astype(dtype, copy=True)
-
-        numeric: Dict[str, np.ndarray] = {}
-        longs: Dict[str, np.ndarray] = {}
-        strings: Dict[str, np.ndarray] = {}
-        bags: Dict[str, FeatureBagColumn] = {}
-        for i, op in enumerate(program):
-            fname = names[i]
-            if op in (_OP_DOUBLE, _OP_OPT_DOUBLE, _OP_FLOAT, _OP_LONG):
-                numeric[fname] = arr(lib.avro_dec_numeric(ctx, i), n, np.float64)
-                if op == _OP_LONG:
-                    longs[fname] = arr(lib.avro_dec_longcol(ctx, i), n, np.int64)
-            elif op in (_OP_STR, _OP_OPT_STR):
-                strings[fname] = arr(lib.avro_dec_strcol(ctx, i), n, np.int32)
-            elif op == _OP_BAG:
-                nnz = int(lib.avro_dec_bag_len(ctx, i))
-                bags[fname] = FeatureBagColumn(
-                    offsets=arr(lib.avro_dec_bag_offsets(ctx, i), n + 1, np.int64),
-                    key_ids=arr(lib.avro_dec_bag_keys(ctx, i), nnz, np.int32),
-                    values=arr(lib.avro_dec_bag_values(ctx, i), nnz, np.float64),
-                )
-        m = int(lib.avro_dec_meta_len(ctx))
-        meta_rows = arr(lib.avro_dec_meta_rows(ctx), m, np.int32)
-        meta_keys = arr(lib.avro_dec_meta_keys(ctx), m, np.int32)
-        meta_vals = arr(lib.avro_dec_meta_vals(ctx), m, np.int32)
-
-        n_intern = int(lib.avro_dec_intern_count(ctx))
-        blob_len = int(lib.avro_dec_intern_blob_len(ctx))
-        blob = ctypes.string_at(lib.avro_dec_intern_blob(ctx), blob_len)
-        offs = arr(lib.avro_dec_intern_offsets(ctx), n_intern + 1, np.int64)
-        intern = [
-            blob[offs[i]:offs[i + 1]].decode("utf-8") for i in range(n_intern)
-        ]
-        return ColumnarRows(
-            n=n, numeric=numeric, longs=longs, strings=strings, bags=bags,
-            meta_rows=meta_rows, meta_keys=meta_keys, meta_vals=meta_vals,
-            intern=intern,
-        )
+        for gen in gens:
+            for count, data in gen:
+                rc = lib.avro_dec_block(ctx, data, len(data), count)
+                if rc != 0:
+                    return None  # malformed vs program: Python-codec fallback
+        return _extract_columns(lib, ctx, program, names)
     finally:
         lib.avro_dec_free(ctx)
+        for g in gens:
+            g.close()
+
+
+def stream_avro_columnar(paths: Sequence[str], chunk_rows: int = 1 << 16):
+    """Yield ColumnarRows chunks of >= chunk_rows rows (block-aligned):
+    the streaming ingest path (SURVEY §7 hard part 4, VERDICT r3 #5). Host
+    memory is bounded by one chunk + one decompressed block, never the
+    file. Raises (rather than returning None) when the native decoder or
+    schema can't serve the stream — streaming callers need a hard error,
+    not a silent slurp."""
+    lib = _load_lib()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable for streaming ingest")
+    compiled = _compile_for_paths(paths)
+    if compiled is None:
+        raise ValueError(
+            "schema outside the native columnar program (or heterogeneous "
+            "schemas); streaming ingest unavailable"
+        )
+    program, names, gens = compiled
+    ctx = lib.avro_dec_new(program, len(program))
+    try:
+        for gen in gens:
+            for count, data in gen:
+                rc = lib.avro_dec_block(ctx, data, len(data), count)
+                if rc != 0:
+                    raise ValueError("malformed container block")
+                if int(lib.avro_dec_num_records(ctx)) >= chunk_rows:
+                    yield _extract_columns(lib, ctx, program, names)
+                    lib.avro_dec_free(ctx)
+                    ctx = lib.avro_dec_new(program, len(program))
+        if int(lib.avro_dec_num_records(ctx)) > 0:
+            yield _extract_columns(lib, ctx, program, names)
+    finally:
+        lib.avro_dec_free(ctx)
+        for g in gens:
+            g.close()

@@ -1,0 +1,120 @@
+"""Streaming ingest: chunked columnar decode with bounded host memory.
+
+VERDICT r3 #5 / SURVEY §7 hard part 4: the round-3 reader slurped whole
+container files and materialized every decompressed block; these tests pin
+the streaming contract — block-incremental reads, chunk-bounded decode,
+bit parity with the slurp path, cumulative entity interning, and a
+device-feed assembly via concat_game_batches.
+"""
+
+import numpy as np
+import pytest
+
+from photon_tpu.io.avro import write_avro_records
+from photon_tpu.io.columnar import _load_lib, stream_avro_columnar, stream_blocks
+from photon_tpu.io.data_reader import (
+    FeatureShardConfig,
+    concat_game_batches,
+    read_merged,
+    stream_merged,
+)
+from photon_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+rng = np.random.default_rng(123)
+
+native_available = pytest.mark.skipif(
+    _load_lib() is None, reason="no C++ toolchain for the native decoder"
+)
+
+
+def _write(path, n=1000, d=12, block_rows=97):
+    records = []
+    for i in range(n):
+        nnz = int(rng.integers(1, d))
+        idx = rng.choice(d, size=nnz, replace=False)
+        records.append({
+            "uid": str(i),
+            "label": float(i % 2),
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(rng.normal())}
+                for j in idx
+            ],
+            "metadataMap": {"userId": f"u{i % 23}"},
+            "weight": 1.0 + (i % 3),
+            "offset": 0.25 * (i % 4),
+        })
+    write_avro_records(str(path), TRAINING_EXAMPLE_SCHEMA, records,
+                       block_records=block_rows)
+    return records
+
+
+def test_stream_blocks_is_incremental(tmp_path):
+    """stream_blocks must read the file lazily: consuming one block must not
+    consume the whole file handle."""
+    path = tmp_path / "s.avro"
+    _write(path, n=500, block_rows=50)
+    schema, gen = stream_blocks(str(path))
+    assert schema["name"].endswith("TrainingExampleAvro")
+    first = next(gen)
+    assert first[0] == 50 and len(first[1]) > 0
+    total = first[0] + sum(c for c, _ in gen)
+    assert total == 500
+
+
+@native_available
+@pytest.mark.parametrize("chunk_rows", [64, 256, 10_000])
+def test_stream_merged_parity_with_slurp(tmp_path, chunk_rows):
+    path = tmp_path / "p.avro"
+    _write(path, n=1000, block_rows=97)
+    cfg = {"s": FeatureShardConfig(feature_bags=["features"])}
+    ids = {"userId": "userId"}
+    full, imaps, eidx_full = read_merged([str(path)], cfg, entity_id_columns=ids)
+
+    eidx_stream = {}
+    chunks = list(stream_merged(
+        [str(path)], cfg, imaps, entity_id_columns=ids,
+        entity_indexes=eidx_stream, chunk_rows=chunk_rows,
+    ))
+    if chunk_rows < 1000:
+        assert len(chunks) > 1
+        # chunk bound: block-aligned, so at most chunk_rows + one block over
+        assert all(c.n <= chunk_rows + 97 for c in chunks)
+    merged = concat_game_batches(chunks)
+    assert merged.n == full.n == 1000
+    np.testing.assert_array_equal(np.asarray(merged.label), np.asarray(full.label))
+    np.testing.assert_array_equal(np.asarray(merged.weight), np.asarray(full.weight))
+    np.testing.assert_array_equal(np.asarray(merged.offset), np.asarray(full.offset))
+    np.testing.assert_array_equal(
+        np.asarray(merged.features["s"]), np.asarray(full.features["s"])
+    )
+    # Entity interning accumulates across chunks identically to the slurp.
+    np.testing.assert_array_equal(
+        np.asarray(merged.entity_ids["userId"]),
+        np.asarray(full.entity_ids["userId"]),
+    )
+    assert eidx_stream["userId"].ids() == eidx_full["userId"].ids()
+
+
+@native_available
+def test_stream_avro_columnar_chunk_sizes(tmp_path):
+    path = tmp_path / "c.avro"
+    _write(path, n=640, block_rows=64)
+    sizes = [c.n for c in stream_avro_columnar([str(path)], chunk_rows=128)]
+    assert sum(sizes) == 640
+    assert all(s >= 128 for s in sizes[:-1])
+    assert max(sizes) <= 128 + 64  # block-aligned bound
+
+
+@native_available
+def test_stream_merged_requires_native(tmp_path, monkeypatch):
+    """Streaming is a hard error without the native decoder — never a
+    silent whole-file fallback."""
+    import photon_tpu.io.columnar as col
+
+    path = tmp_path / "x.avro"
+    _write(path, n=10)
+    monkeypatch.setattr(col, "_lib", None)
+    monkeypatch.setattr(col, "_lib_failed", True)
+    cfg = {"s": FeatureShardConfig(feature_bags=["features"])}
+    with pytest.raises(RuntimeError, match="native decoder"):
+        list(stream_merged([str(path)], cfg, {}, chunk_rows=4))
