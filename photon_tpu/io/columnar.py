@@ -81,9 +81,28 @@ def _load_lib():
         return _lib
     so = os.path.abspath(_lib_path())
     src = os.path.join(os.path.dirname(so), "avro_decode.cpp")
-    if not os.path.exists(so) or (
-        os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(so)
-    ):
+    # Build when the library is missing (source-only distribution; ci.sh
+    # `native` is the sanctioned build). Rebuild-on-source-mtime is a dev
+    # convenience only — writes into an installed package dir, so opt-in
+    # (ADVICE r3).
+    src_newer = (
+        os.path.exists(src)
+        and os.path.exists(so)
+        and os.path.getmtime(src) > os.path.getmtime(so)
+    )
+    rebuild_enabled = os.environ.get("PHOTON_TPU_NATIVE_REBUILD") == "1"
+    if src_newer and not rebuild_enabled:
+        import warnings
+
+        warnings.warn(
+            "photon_tpu/native/avro_decode.cpp is newer than the built "
+            "libavro_decode.so — run `./ci.sh native` or set "
+            "PHOTON_TPU_NATIVE_REBUILD=1 to rebuild; loading the stale "
+            "binary",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    if not os.path.exists(so) or (src_newer and rebuild_enabled):
         try:
             subprocess.run(
                 ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, src],
