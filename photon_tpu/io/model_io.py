@@ -38,13 +38,30 @@ METADATA_FILE = "model-metadata.json"
 ID_INFO_FILE = "id-info"
 COEFF_DIR = "coefficients"
 
+# Fully-qualified class names: the reference loader instantiates models via
+# Class.forName(modelClass) (AvroUtils.scala:390), so models this framework
+# writes must carry the reference's FQCNs to be loadable there. (The
+# smoothed-hinge task has no model class in the reference tree; the logistic
+# classifier is the closest loadable stand-in.)
 _MODEL_CLASS = {
-    TaskType.LOGISTIC_REGRESSION: "LogisticRegressionModel",
-    TaskType.LINEAR_REGRESSION: "LinearRegressionModel",
-    TaskType.POISSON_REGRESSION: "PoissonRegressionModel",
-    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "SmoothedHingeLossLinearSVMModel",
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
 }
-_CLASS_MODEL = {v: k for k, v in _MODEL_CLASS.items()}
+# Reader accepts both FQCN and bare class name (this repo's rounds <= 3
+# wrote bare names). Hinge aliases to logistic in _MODEL_CLASS, so the
+# reverse map is spelled out.
+_CLASS_MODEL = {
+    "LogisticRegressionModel": TaskType.LOGISTIC_REGRESSION,
+    "LinearRegressionModel": TaskType.LINEAR_REGRESSION,
+    "PoissonRegressionModel": TaskType.POISSON_REGRESSION,
+    "SmoothedHingeLossLinearSVMModel": TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+}
 
 
 def _split_key(key: str) -> Tuple[str, str]:
@@ -95,7 +112,8 @@ def _avro_to_coeffs(rec: dict, index_map: IndexMap, dim: int):
             j = index_map.get_index(IndexMap.key(ntv["name"], ntv["term"]))
             if j >= 0:
                 variances[j] = ntv["value"]
-    task = _CLASS_MODEL.get(rec.get("modelClass") or "", TaskType.LOGISTIC_REGRESSION)
+    cls_name = (rec.get("modelClass") or "").rsplit(".", 1)[-1]
+    task = _CLASS_MODEL.get(cls_name)  # None when the class is unrecognized
     return means, variances, task
 
 
@@ -263,7 +281,12 @@ def _scan_model_dir(model_dir: str, meta: dict) -> Dict[str, dict]:
         for cid in sorted(os.listdir(fdir)):
             with open(os.path.join(fdir, cid, ID_INFO_FILE)) as f:
                 (shard,) = f.read().split()
-            coords[cid] = {"type": "fixed", "featureShard": shard, "task": task}
+            coords[cid] = {
+                "type": "fixed", "featureShard": shard, "task": task,
+                # metadata carried no per-coordinate task: the coefficient
+                # records' modelClass may refine it at load time.
+                "task_inferred": True,
+            }
     rdir = os.path.join(model_dir, RANDOM_DIR)
     if os.path.isdir(rdir):
         for cid in sorted(os.listdir(rdir)):
@@ -271,7 +294,7 @@ def _scan_model_dir(model_dir: str, meta: dict) -> Dict[str, dict]:
                 re_type, shard = f.read().split()
             coords[cid] = {
                 "type": "random", "reType": re_type, "featureShard": shard,
-                "task": task,
+                "task": task, "task_inferred": True,
             }
     return coords
 
@@ -334,7 +357,9 @@ def load_game_model(
                     f"fixed-effect coordinate {cid!r}: expected exactly one "
                     f"coefficient record across part files, got {len(recs)}"
                 )
-            means, variances, _ = _avro_to_coeffs(recs[0], imap, dim)
+            means, variances, rec_task = _avro_to_coeffs(recs[0], imap, dim)
+            if info.get("task_inferred") and rec_task is not None:
+                task = rec_task  # modelClass beats the modelType guess
             models[cid] = FixedEffectModel(
                 GeneralizedLinearModel(
                     Coefficients(
@@ -361,7 +386,9 @@ def load_game_model(
             variances_arr = None
             for rec in recs:
                 e = eidx.lookup(rec["modelId"])
-                means, variances, _ = _avro_to_coeffs(rec, imap, dim)
+                means, variances, rec_task = _avro_to_coeffs(rec, imap, dim)
+                if info.get("task_inferred") and rec_task is not None:
+                    task = rec_task  # modelClass beats the modelType guess
                 coefs[e] = means
                 if variances is not None:
                     if variances_arr is None:

@@ -243,3 +243,85 @@ def test_game_input_fixtures_read(tmp_path):
     np.testing.assert_array_equal(
         _feats_dense(fast.features["s"]), _feats_dense(slow.features["s"])
     )
+
+
+def test_score_reference_input_with_reference_model():
+    """Full load->score on 100% reference-written artifacts: the
+    retrainModels/mixedEffects GAME model (Java Avro, FQCN model classes)
+    scores the yahoo-music input rows (long id columns) — fixed + per-song
+    + per-artist contributions, finite everywhere, and random effects
+    actually fire for entities present in the model (reference
+    GameScoringDriverIntegTest role)."""
+    import glob as globlib
+
+    from photon_tpu.io.model_io import load_game_model
+
+    mdir = os.path.join(GAME, "retrainModels", "mixedEffects")
+    # Shard -> bags mapping from the reference's own integ test config
+    # (GameTrainingDriverIntegTest.scala:760-762).
+    shard_bags = {
+        "shard1": ["features", "userFeatures", "songFeatures"],
+        "shard2": ["features", "userFeatures"],
+        "shard3": ["songFeatures"],
+    }
+    # Index maps per shard from the model files (the authoritative feature
+    # space for scoring a saved model).
+    # Merge coefficient files per shard ACROSS coordinates before building
+    # each shard's index map (per-artist and per-song share shard2 but have
+    # nearly disjoint feature sets — a map from one coordinate alone would
+    # silently truncate the other).
+    shard_files = {}
+    for sub in ("fixed-effect", "random-effect"):
+        base = os.path.join(mdir, sub)
+        for cid in os.listdir(base):
+            with open(os.path.join(base, cid, "id-info")) as f:
+                shard = f.read().split()[-1]
+            shard_files.setdefault(shard, []).extend(globlib.glob(
+                os.path.join(base, cid, "coefficients", "*.avro")
+            ))
+    index_maps = {}
+    for shard, files in shard_files.items():
+        if files:
+            index_maps[shard], _ = _index_map_from_model_records(files)
+        else:  # id-info-only coordinates: empty feature space
+            index_maps[shard] = IndexMap.build([], add_intercept=False)
+    entity_indexes = {}
+    model = load_game_model(mdir, index_maps, entity_indexes)
+    assert set(model.models) == {"global", "per-song", "per-artist", "per-user"}
+
+    # Read the reference input with the model's feature spaces and entity
+    # interning (so gather indices align with model rows).
+    yahoo = os.path.join(GAME, "input", "duplicateFeatures", "yahoo-music-train.avro")
+    shard_cfgs = {
+        shard: FeatureShardConfig(feature_bags=bags, has_intercept=False,
+                                  dense_dim_limit=1 << 20)
+        for shard, bags in shard_bags.items()
+        if shard in index_maps
+    }
+    batch, _, _ = read_merged(
+        [yahoo], shard_cfgs, index_maps=index_maps,
+        entity_id_columns={"songId": "songId", "artistId": "artistId"},
+        entity_indexes=entity_indexes, intern_new_entities=False,
+    )
+    assert batch.n > 0
+
+    from photon_tpu.models.game import RandomEffectModel
+
+    total = np.zeros(batch.n, np.float32)
+    re_hits = 0
+    for cid, sub in model.models.items():
+        if sub.feature_shard not in batch.features:
+            continue  # per-user shipped no coefficients (id-info only)
+        if isinstance(sub, RandomEffectModel) and sub.coefficients.shape[0] == 0:
+            continue
+        s = np.asarray(sub.score(batch))
+        assert np.isfinite(s).all(), cid
+        if isinstance(sub, RandomEffectModel):
+            ids = np.asarray(batch.entity_ids[sub.re_type])
+            known = ids >= 0
+            re_hits += int(known.sum())
+            # unknown entities contribute exactly zero
+            assert np.all(s[~known] == 0.0), cid
+        total += s
+    assert np.isfinite(total).all()
+    assert re_hits > 0, "no input row matched any model entity"
