@@ -34,10 +34,25 @@ class SparseFeatures:
     scatter-add, both of which XLA compiles to efficient TPU programs.
     """
 
-    def __init__(self, indices: Array, values: Array, dim: int):
+    def __init__(
+        self,
+        indices: Array,
+        values: Array,
+        dim: int,
+        csc_order: Optional[Array] = None,
+        csc_segments: Optional[Array] = None,
+    ):
         self.indices = indices  # (n, k) int32
         self.values = values  # (n, k) float
         self.dim = int(dim)
+        # Optional precomputed transpose plan (see with_transpose_plan):
+        # csc_order sorts the flattened nnz entries by column, csc_segments
+        # are the sorted column ids. When present, rmatvec uses a gather +
+        # segment_sum instead of a duplicate-index scatter-add — the sorted
+        # form is the TPU-friendly lowering (XLA serializes colliding
+        # scatter updates).
+        self.csc_order = csc_order  # (n*k,) int32 or None
+        self.csc_segments = csc_segments  # (n*k,) int32 or None
 
     @property
     def shape(self):
@@ -48,10 +63,29 @@ class SparseFeatures:
         return jnp.sum(self.values * w[self.indices], axis=-1)
 
     def rmatvec(self, r: Array) -> Array:
-        """X.T @ r via scatter-add: (d,)."""
+        """X.T @ r: segment-sum over the precomputed column-sorted plan when
+        available, duplicate-index scatter-add otherwise."""
         d = self.dim
         contrib = self.values * r[:, None]
+        if self.csc_order is not None:
+            sorted_contrib = contrib.reshape(-1)[self.csc_order]
+            return jax.ops.segment_sum(
+                sorted_contrib, self.csc_segments, num_segments=d,
+                indices_are_sorted=True,
+            )
         return jnp.zeros((d,), dtype=self.values.dtype).at[self.indices].add(contrib)
+
+    def with_transpose_plan(self) -> "SparseFeatures":
+        """Return a copy carrying the column-sorted transpose plan (one host
+        argsort over the static index pattern; ~2 extra int32 nnz-sized
+        arrays in device memory)."""
+        flat = np.asarray(self.indices).reshape(-1)
+        order = np.argsort(flat, kind="stable")
+        return SparseFeatures(
+            self.indices, self.values, self.dim,
+            csc_order=jnp.asarray(order.astype(np.int32)),
+            csc_segments=jnp.asarray(flat[order].astype(np.int32)),
+        )
 
     def to_dense(self) -> Array:
         n, k = self.values.shape
@@ -59,12 +93,15 @@ class SparseFeatures:
         return out.at[jnp.arange(n)[:, None], self.indices].add(self.values)
 
     def tree_flatten(self):
-        return (self.indices, self.values), (self.dim,)
+        return (
+            (self.indices, self.values, self.csc_order, self.csc_segments),
+            (self.dim,),
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        indices, values = children
-        return cls(indices, values, aux[0])
+        indices, values, csc_order, csc_segments = children
+        return cls(indices, values, aux[0], csc_order, csc_segments)
 
     @staticmethod
     def from_rows(rows, dim: int, dtype=np.float32) -> "SparseFeatures":

@@ -55,12 +55,17 @@ _MODEL_CLASS = {
 }
 # Reader accepts both FQCN and bare class name (this repo's rounds <= 3
 # wrote bare names). Hinge aliases to logistic in _MODEL_CLASS, so the
-# reverse map is spelled out.
+# reverse map is spelled out — and the record's lossFunction field is
+# consulted FIRST (it distinguishes hinge from logistic where the
+# class name cannot).
 _CLASS_MODEL = {
     "LogisticRegressionModel": TaskType.LOGISTIC_REGRESSION,
     "LinearRegressionModel": TaskType.LINEAR_REGRESSION,
     "PoissonRegressionModel": TaskType.POISSON_REGRESSION,
     "SmoothedHingeLossLinearSVMModel": TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+}
+_LOSS_TASK = {
+    loss_for_task(t).name: t for t in TaskType
 }
 
 
@@ -112,8 +117,10 @@ def _avro_to_coeffs(rec: dict, index_map: IndexMap, dim: int):
             j = index_map.get_index(IndexMap.key(ntv["name"], ntv["term"]))
             if j >= 0:
                 variances[j] = ntv["value"]
-    cls_name = (rec.get("modelClass") or "").rsplit(".", 1)[-1]
-    task = _CLASS_MODEL.get(cls_name)  # None when the class is unrecognized
+    task = _LOSS_TASK.get(rec.get("lossFunction") or "")
+    if task is None:
+        cls_name = (rec.get("modelClass") or "").rsplit(".", 1)[-1]
+        task = _CLASS_MODEL.get(cls_name)  # None when unrecognized
     return means, variances, task
 
 

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures
 from photon_tpu.data.random_effect import EntityBlock
 from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.optim.common import OptimizerConfig
@@ -168,9 +168,19 @@ def glmix_sharded_train_step(
 
     def place(w_fixed, re_coefs, fe_batch, re_block, re_features_flat, re_entity_ids):
         put = jax.device_put
+        feats = fe_batch.features
+        if isinstance(feats, SparseFeatures):
+            # A transpose plan (flat column-sorted nnz order) is only valid
+            # for the unsharded layout — rebuild without it; the sharded
+            # gradient uses the scatter-add path per shard.
+            feats = SparseFeatures(
+                put(feats.indices, rows2d), put(feats.values, rows2d), feats.dim
+            )
+        else:
+            feats = put(feats, rows2d)
         fe = LabeledBatch(
             label=put(fe_batch.label, rows),
-            features=put(fe_batch.features, rows2d),
+            features=feats,
             offset=put(fe_batch.offset, rows),
             weight=put(fe_batch.weight, rows),
             uid=None,

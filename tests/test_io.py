@@ -199,3 +199,38 @@ def test_scores_round_trip(tmp_path):
     assert [r["predictionScore"] for r in back] == pytest.approx(scores.tolist())
     assert [r["uid"] for r in back] == ["a", "b", "c"]
     assert back[0]["modelId"] == "my-model"
+
+
+def test_hinge_model_task_survives_metadata_loss(tmp_path):
+    """The hinge task aliases to the logistic FQCN in modelClass (the
+    reference has no hinge model class); when a saved model dir loses its
+    metadata, the reader must recover SMOOTHED_HINGE from the record's
+    lossFunction field, not silently reload as logistic."""
+    import os
+
+    import jax.numpy as jnp
+
+    from photon_tpu.data.index_map import IndexMap
+    from photon_tpu.io.model_io import load_game_model, save_game_model
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import FixedEffectModel, GameModel
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.types import TaskType
+
+    imap = IndexMap.build({"a", "b"}, add_intercept=True)
+    w = jnp.asarray([0.5, -0.25, 0.75])
+    model = GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(w, None),
+                TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+            ),
+            "s",
+        )
+    })
+    out = tmp_path / "m"
+    save_game_model(model, str(out), {"s": imap})
+    os.remove(out / "model-metadata.json")  # force the directory-scan path
+    loaded = load_game_model(str(out), {"s": imap})
+    sub = loaded.models["global"]
+    assert sub.model.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM

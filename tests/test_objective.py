@@ -150,3 +150,45 @@ def test_transformed_to_model_space_scores_match():
     np.testing.assert_allclose(
         norm.model_to_transformed_space(w_model), w_t, rtol=1e-3, atol=1e-3
     )
+
+
+def test_sparse_transpose_plan_rmatvec_parity():
+    """with_transpose_plan's gather+segment_sum X^T r must equal the
+    scatter-add path bitwise-ish (same f32 sums, different order: allclose),
+    and the margin solver must reach the same optimum through either."""
+    import numpy as np
+
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.optim.common import OptimizerConfig
+    from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
+
+    rng = np.random.default_rng(17)
+    n, d, k = 512, 4096, 8
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    idx[:, 0] = 0
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    vals[:, 0] = 1.0
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+
+    plain = SparseFeatures(jnp.asarray(idx), jnp.asarray(vals), d)
+    planned = plain.with_transpose_plan()
+    r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(plain.rmatvec(r)), np.asarray(planned.rmatvec(r)),
+        rtol=1e-5, atol=1e-5,
+    )
+    # matvec unchanged by the plan
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(plain.matvec(w)), np.asarray(planned.matvec(w))
+    )
+
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0, intercept_index=0)
+    cfg = OptimizerConfig(max_iter=25, track_history=False)
+    w0 = jnp.zeros(d, jnp.float32)
+    res_a = minimize_lbfgs_margin(obj, LabeledBatch(jnp.asarray(y), plain), w0, cfg)
+    res_b = minimize_lbfgs_margin(obj, LabeledBatch(jnp.asarray(y), planned), w0, cfg)
+    np.testing.assert_allclose(
+        np.asarray(res_a.w), np.asarray(res_b.w), rtol=2e-4, atol=2e-5
+    )
