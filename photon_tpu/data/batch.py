@@ -66,14 +66,16 @@ class SparseFeatures:
         """X.T @ r: segment-sum over the precomputed column-sorted plan when
         available, duplicate-index scatter-add otherwise."""
         d = self.dim
-        contrib = self.values * r[:, None]
+        contrib = self.values * r[:, None]  # promotes bf16 values to r.dtype
         if self.csc_order is not None:
             sorted_contrib = contrib.reshape(-1)[self.csc_order]
             return jax.ops.segment_sum(
                 sorted_contrib, self.csc_segments, num_segments=d,
                 indices_are_sorted=True,
             )
-        return jnp.zeros((d,), dtype=self.values.dtype).at[self.indices].add(contrib)
+        # Accumulate at the PROMOTED dtype — a bf16-storage matrix must not
+        # sum its gradient in bf16.
+        return jnp.zeros((d,), dtype=contrib.dtype).at[self.indices].add(contrib)
 
     def with_transpose_plan(self) -> "SparseFeatures":
         """Return a copy carrying the column-sorted transpose plan (one host

@@ -192,3 +192,34 @@ def test_sparse_transpose_plan_rmatvec_parity():
     np.testing.assert_allclose(
         np.asarray(res_a.w), np.asarray(res_b.w), rtol=2e-4, atol=2e-5
     )
+
+
+def test_sparse_bf16_values_accumulate_gradient_in_f32():
+    """bf16-stored values must still produce an f32 gradient accumulated at
+    f32 (not summed in bf16), on both rmatvec lowerings."""
+    import ml_dtypes
+    import numpy as np
+
+    from photon_tpu.data.batch import SparseFeatures
+
+    rng = np.random.default_rng(3)
+    n, d, k = 256, 64, 16
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    f32 = SparseFeatures(jnp.asarray(idx), jnp.asarray(vals), d)
+    bf = SparseFeatures(
+        jnp.asarray(idx), jnp.asarray(vals.astype(ml_dtypes.bfloat16)), d
+    )
+    g32 = f32.rmatvec(r)
+    g_bf_scatter = bf.rmatvec(r)
+    g_bf_seg = bf.with_transpose_plan().rmatvec(r)
+    assert g_bf_scatter.dtype == jnp.float32
+    assert g_bf_seg.dtype == jnp.float32
+    # storage rounding only: well within bf16's ~3 decimal digits over k=16 sums
+    np.testing.assert_allclose(
+        np.asarray(g_bf_scatter), np.asarray(g32), rtol=0.05, atol=0.2
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_bf_seg), np.asarray(g_bf_scatter), rtol=1e-5, atol=1e-5
+    )
