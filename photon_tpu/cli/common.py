@@ -118,7 +118,32 @@ def add_common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--input-data-days-range", default=None,
                    help="start-end days ago (reference inputDataDaysRange)")
     p.add_argument("--override-output-dir", action="store_true")
+    p.add_argument(
+        "--input-column-names", default=None,
+        help="remap reserved columns (reference inputColumnNames / "
+             "InputColumnsNames), e.g. "
+             "response=the_label,weight=w,offset=off,uid=id,metadata=meta",
+    )
     p.add_argument("--verbose", action="store_true")
+
+
+def parse_input_column_names(spec):
+    """'response=the_label,weight=w' → InputColumnsNames (None passthrough)."""
+    if not spec:
+        return None
+    from photon_tpu.io.data_reader import InputColumnsNames
+
+    allowed = {"response", "offset", "weight", "uid", "metadata"}
+    kwargs = {}
+    for part in spec.split(","):
+        key, _, value = part.partition("=")
+        key = key.strip()
+        if key not in allowed or not value:
+            raise ValueError(
+                f"bad --input-column-names entry {part!r}; keys: {sorted(allowed)}"
+            )
+        kwargs[key] = value.strip()
+    return InputColumnsNames(**kwargs)
 
 
 def add_validation_arg(p: argparse.ArgumentParser) -> None:

@@ -67,7 +67,7 @@ def run(args) -> Dict:
 
     model = load_game_model(args.model_input_dir, index_maps, entity_indexes)
 
-    from photon_tpu.cli.common import resolve_input_paths
+    from photon_tpu.cli.common import parse_input_column_names, resolve_input_paths
     from photon_tpu.utils.io_utils import process_output_dir
 
     process_output_dir(args.output_dir, args.override_output_dir)
@@ -75,6 +75,9 @@ def run(args) -> Dict:
         resolve_input_paths(args), shard_configs, index_maps=index_maps,
         entity_id_columns={rt: rt for rt in re_types},
         entity_indexes=entity_indexes, intern_new_entities=False,
+        column_names=parse_input_column_names(
+            getattr(args, "input_column_names", None)
+        ),
     )
 
     suite = None

@@ -141,15 +141,19 @@ def run(args) -> Dict:
         if hasattr(c, "re_type")
     }
 
-    from photon_tpu.cli.common import resolve_input_paths
+    from photon_tpu.cli.common import parse_input_column_names, resolve_input_paths
     from photon_tpu.data.validators import DataValidationType, validate_game_batch
     from photon_tpu.utils.io_utils import process_output_dir
 
+    column_names = parse_input_column_names(
+        getattr(args, "input_column_names", None)
+    )
     process_output_dir(args.output_dir, args.override_output_dir)
     with Timed("driver/read-train"):
         batch, index_maps, entity_indexes = read_merged(
             resolve_input_paths(args), shard_configs,
             entity_id_columns=entity_id_columns,
+            column_names=column_names,
         )
     # Row-level sanity checks on train + validation data
     # (GameTrainingDriver.scala:415-432).
@@ -161,7 +165,7 @@ def run(args) -> Dict:
             valid_batch, _, _ = read_merged(
                 args.validation_paths, shard_configs, index_maps=index_maps,
                 entity_id_columns=entity_id_columns, entity_indexes=entity_indexes,
-                intern_new_entities=False,
+                intern_new_entities=False, column_names=column_names,
             )
         validate_game_batch(valid_batch, task, validation_mode)
 

