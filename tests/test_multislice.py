@@ -155,3 +155,14 @@ def test_evaluators_exact_on_sharded_scores():
         sharded = float(jax.jit(fn)(sh(scores), sh(labels), sh(weight)))
         np.testing.assert_allclose(sharded, plain, rtol=1e-5, atol=1e-6,
                                    err_msg=name)
+
+    # Grouped (per-entity) AUC: the global lexicographic sort + segment ops
+    # must be exact over sharded inputs too.
+    gids = rng.integers(0, 16, size=n).astype(np.int32)
+    g = jax.jit(ev.grouped_auc, static_argnames="num_groups")
+    plain = float(g(jnp.asarray(scores), jnp.asarray(labels),
+                    jnp.asarray(gids), num_groups=16, weight=jnp.asarray(weight)))
+    sharded = float(g(sh(scores), sh(labels), sh(gids), num_groups=16,
+                      weight=sh(weight)))
+    np.testing.assert_allclose(sharded, plain, rtol=1e-5, atol=1e-6,
+                               err_msg="grouped_auc")
