@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the axon tunnel; when healthy, capture the round-4 evidence pack.
+cd /root/repo
+for i in $(seq 1 60); do
+  if timeout 120 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
+    echo "$(date +%T) tunnel healthy - starting bench pack (probe $i)"
+    python -u bench.py --pack BENCH_PACK_r04.jsonl --trace-dir /root/repo/artifacts/trace_r04 > /root/repo/bench_pack_r04.log 2>&1
+    echo "$(date +%T) pack finished rc=$?"
+    exit 0
+  fi
+  echo "$(date +%T) tunnel wedged (probe $i)"
+  sleep 540
+done
+echo "gave up after 60 probes"
+exit 1
