@@ -357,3 +357,90 @@ def test_game_training_cli_with_custom_column_names(tmp_path):
 
     meta = _json.loads((out / "best" / "model-metadata.json").read_text())
     assert meta["coordinates"]["global"]["featureShard"] == "s"
+
+
+def test_partial_retrain_from_reference_model():
+    """Reference retrainModels semantics on reference artifacts: warm-start
+    from the Java-written fixedEffectsOnly model, LOCK the fixed coordinate,
+    and train a fresh per-song random effect against its residuals on the
+    yahoo-music input. The locked coefficients must come through untouched;
+    the new RE must actually train (reference partial-retrain integ test,
+    lockedCoordinates / CoordinateDescent.scala:280-300)."""
+    import glob as globlib
+
+    import jax.numpy as jnp
+
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        GameOptimizationConfig,
+        RandomEffectCoordinateConfig,
+        RegularizationConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.io.model_io import load_game_model
+    from photon_tpu.models.game import FixedEffectModel, RandomEffectModel
+    from photon_tpu.types import TaskType
+
+    mdir = os.path.join(GAME, "retrainModels", "fixedEffectsOnly")
+    files = globlib.glob(
+        os.path.join(mdir, "fixed-effect", "global", "coefficients", "*.avro")
+    )
+    imap, _ = _index_map_from_model_records(files)
+    entity_indexes = {}
+    warm = load_game_model(mdir, {"shard1": imap}, entity_indexes)
+    (fixed_sub,) = warm.models.values()
+    assert isinstance(fixed_sub, FixedEffectModel)
+    w_ref = np.asarray(fixed_sub.model.coefficients.means).copy()
+
+    yahoo = os.path.join(GAME, "input", "duplicateFeatures", "yahoo-music-train.avro")
+    shard_cfgs = {
+        "shard1": FeatureShardConfig(
+            feature_bags=["features", "userFeatures", "songFeatures"],
+            has_intercept=False, dense_dim_limit=1 << 20,
+        ),
+        "songShard": FeatureShardConfig(
+            feature_bags=["songFeatures"], has_intercept=True,
+        ),
+    }
+    # songShard's map comes from a distinct scan of the input; shard1's map
+    # must be the MODEL's feature space (scoring alignment), so read once to
+    # build the song map and again with the mixed maps.
+    _, scanned, _ = read_merged([yahoo], shard_cfgs)
+    batch, imaps, eidx = read_merged(
+        [yahoo], shard_cfgs,
+        index_maps={"shard1": imap, "songShard": scanned["songShard"]},
+        entity_id_columns={"songId": "songId"},
+    )
+    n_songs = len(eidx["songId"])
+    assert n_songs > 0
+
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=[
+            FixedEffectCoordinateConfig("global", "shard1"),
+            RandomEffectCoordinateConfig("per-song", "songId", "songShard"),
+        ],
+        num_iterations=1,
+        locked_coordinates=["global"],
+        intercept_indices={
+            "songShard": imaps["songShard"].get_index(IndexMap.INTERCEPT)
+        },
+        num_entities={"songId": n_songs},
+    )
+    cfg = GameOptimizationConfig(reg={
+        "global": RegularizationConfig(weight=1.0),
+        "per-song": RegularizationConfig(weight=1.0),
+    })
+    (res,) = est.fit(batch, optimization_configs=[cfg], initial_model=warm)
+    out = res.model
+    # Locked fixed effect: bit-identical to the loaded reference model.
+    np.testing.assert_array_equal(
+        np.asarray(out.models["global"].model.coefficients.means), w_ref
+    )
+    re_sub = out.models["per-song"]
+    assert isinstance(re_sub, (RandomEffectModel, type(re_sub)))
+    coefs = np.asarray(
+        re_sub.coefficients if hasattr(re_sub, "coefficients") else 0
+    )
+    assert np.isfinite(coefs).all()
+    assert float(np.abs(coefs).sum()) > 0.0, "locked retrain trained nothing"
