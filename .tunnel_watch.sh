@@ -1,15 +1,42 @@
 #!/bin/bash
 # Probe the axon tunnel; when healthy, capture the round-4 evidence pack.
+# The pack is RESUMABLE (bench.py --pack skips already-captured sections),
+# so this loop retries across wedges until every section has a clean line.
+# One TPU process at a time; probes use the documented timeout-probe recipe
+# (project memory: axon-tpu-tunnel-fragility).
 cd /root/repo
-for i in $(seq 1 60); do
+PACK=BENCH_PACK_r04.jsonl
+pack_complete() {
+  python - "$PACK" << 'PYEOF'
+import json, sys
+need = 7
+clean = set()
+try:
+    for line in open(sys.argv[1]):
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if r.get("metric") and "error" not in r:
+            clean.add(r["metric"])
+except OSError:
+    pass
+sys.exit(0 if len(clean) >= need else 1)
+PYEOF
+}
+for i in $(seq 1 70); do
   if timeout 120 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
-    echo "$(date +%T) tunnel healthy - starting bench pack (probe $i)"
-    python -u bench.py --pack BENCH_PACK_r04.jsonl --trace-dir /root/repo/artifacts/trace_r04 > /root/repo/bench_pack_r04.log 2>&1
-    echo "$(date +%T) pack finished rc=$?"
-    exit 0
+    echo "$(date +%T) tunnel healthy - starting/resuming bench pack (probe $i)"
+    python -u bench.py --pack "$PACK" --trace-dir /root/repo/artifacts/trace_r04 >> /root/repo/bench_pack_r04.log 2>&1
+    echo "$(date +%T) pack attempt rc=$?"
+    if pack_complete; then
+      echo "$(date +%T) pack COMPLETE"
+      exit 0
+    fi
+  else
+    echo "$(date +%T) tunnel wedged (probe $i)"
   fi
-  echo "$(date +%T) tunnel wedged (probe $i)"
   sleep 540
 done
-echo "gave up after 60 probes"
+echo "gave up after 70 probes"
 exit 1

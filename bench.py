@@ -561,8 +561,23 @@ def run_pack(out_path: str) -> None:
     """The full TPU evidence pack in ONE process (the axon tunnel is a
     scarce, breakable resource — one session captures everything). Each
     section's JSON line is appended to ``out_path`` AND printed as soon as
-    it completes, so a mid-run wedge still leaves earlier evidence."""
+    it completes, so a mid-run wedge still leaves earlier evidence.
+    Re-running against an existing file RESUMES: sections that already
+    captured a clean (error-free) line are skipped."""
+    import os
+
     import bench_configs as bc
+
+    captured = set()
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    prev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "error" not in prev and prev.get("metric"):
+                    captured.add(prev["metric"])
 
     sections = [
         ("glmix_logistic_samples_per_sec_per_chip", run_glmix_bench),
@@ -574,6 +589,9 @@ def run_pack(out_path: str) -> None:
         ("game_bayes_tuning_wall_clock", bc.run_game_tuning),
     ]
     for metric, fn in sections:
+        if metric in captured:
+            _progress(f"pack: {metric} already captured — skipping")
+            continue
         _progress(f"pack: {metric}")
         try:
             r = fn()
