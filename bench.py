@@ -325,6 +325,25 @@ def run_profile():
     )
     results["fe_per_iter_ms"] = 1e3 * (t - results["empty_call_s"]) / max(fe_iters, 1)
 
+    # FE with the Pallas fused kernel disabled: isolates what the fused
+    # single-X-pass value+grad+margins kernel buys over plain XLA fusion
+    # (if nothing — or negative — the kernel is not carrying its weight).
+    fe_obj_nopallas = GLMObjective(
+        loss=LogisticLoss, l2_weight=1.0, intercept_index=0, use_pallas=False
+    )
+
+    @jax.jit
+    def fe_only_nopallas(w0):
+        w, ev = w0, jnp.int32(0)
+        for _ in range(CD_PASSES):
+            res = minimize_lbfgs_margin(fe_obj_nopallas, fe_batch, w, fe_cfg)
+            w, ev = res.w, ev + res.evals
+        return w, ev
+    results["fe_only_nopallas_s"] = timeit(
+        fe_only_nopallas,
+        lambda r: (jnp.full((D_FIX,), 1e-4 * (r + 1), jnp.float32),),
+    )
+
     # RE phase alone: CD_PASSES vmapped Newton solves.
     offs0 = block.gather_offsets(jnp.zeros((N,), jnp.float32))
 
