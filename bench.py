@@ -622,6 +622,38 @@ def run_pack(out_path: str) -> None:
             print(json.dumps(r), flush=True)
 
 
+def _backend_watchdog(seconds: int = 240) -> None:
+    """A wedged axon tunnel HANGS jax backend init forever (no exception),
+    which would leave the evidence run with no artifact at all. Block on
+    init under a watchdog: if it doesn't finish in ``seconds``, emit a
+    machine-readable error line and exit. (Self-terminating a process
+    stuck at init is the documented probe recipe — the tunnel is already
+    wedged in that state.)"""
+    import os
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(seconds):
+            print(json.dumps({
+                "metric": "glmix_logistic_samples_per_sec_per_chip",
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "error": "backend-init-timeout",
+                "detail": f"jax backend init exceeded {seconds}s "
+                          "(wedged axon tunnel)",
+            }), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+    import jax
+
+    jax.devices()  # blocks here when the tunnel is wedged
+    done.set()
+
+
 def main():
     import sys
 
@@ -648,8 +680,10 @@ def main():
         except OSError as exc:
             print(f"cannot write pack output {out_path}: {exc}", file=sys.stderr)
             sys.exit(2)
+        _backend_watchdog()
         run_pack(out_path)
         return
+    _backend_watchdog()
     try:
         if "--profile" in sys.argv:
             run_profile()
