@@ -285,33 +285,42 @@ def run_profile():
 
     # Ceiling: K dependent X passes, nothing else — the achievable pure
     # streaming rate for this matrix through this program structure.
+    # All profile jits take the data arrays as ARGUMENTS: a closure capture
+    # would bake the ~1 GB matrix into the HLO as a literal (slow lowering
+    # and a giant program through the tunnel).
     K_PURE = 20
 
     @jax.jit
-    def x_chain(p0):
+    def x_chain(p0, X):
         def body(i, carry):
             p, acc = carry
-            u = jnp.dot(Xf_dev, p.astype(jnp.bfloat16),
+            u = jnp.dot(X, p.astype(jnp.bfloat16),
                         preferred_element_type=jnp.float32)
-            g = jnp.dot(jnp.tanh(u).astype(jnp.bfloat16), Xf_dev,
+            g = jnp.dot(jnp.tanh(u).astype(jnp.bfloat16), X,
                         preferred_element_type=jnp.float32)
             return g / jnp.maximum(jnp.linalg.norm(g), 1.0), acc + jnp.sum(u)
         _, acc = jax.lax.fori_loop(0, K_PURE // 2, body, (p0, jnp.float32(0)))
         return acc
-    t = timeit(x_chain, lambda r: (jnp.full((D_FIX,), 1e-4 * (r + 1), jnp.float32),))
+    t = timeit(
+        x_chain,
+        lambda r: (jnp.full((D_FIX,), 1e-4 * (r + 1), jnp.float32), Xf_dev),
+    )
     results["pure_x_chain_s"] = t
     results["pure_x_gbps"] = K_PURE * x_bytes / (t - results["empty_call_s"]) / 1e9
 
     # FE phase alone: CD_PASSES margin-LBFGS solves (warm-started chain).
     @jax.jit
-    def fe_only(w0):
+    def fe_only(w0, b):
         w, ev = w0, jnp.int32(0)
         for _ in range(CD_PASSES):
-            res = minimize_lbfgs_margin(fe_obj, fe_batch, w, fe_cfg)
+            res = minimize_lbfgs_margin(fe_obj, b, w, fe_cfg)
             w, ev = res.w, ev + res.evals
         return w, ev
-    t = timeit(fe_only, lambda r: (jnp.full((D_FIX,), 1e-4 * (r + 1), jnp.float32),))
-    w_out, fe_ev = fe_only(jnp.full((D_FIX,), 1e-4, jnp.float32))
+    t = timeit(
+        fe_only,
+        lambda r: (jnp.full((D_FIX,), 1e-4 * (r + 1), jnp.float32), fe_batch),
+    )
+    w_out, fe_ev = fe_only(jnp.full((D_FIX,), 1e-4, jnp.float32), fe_batch)
     fe_ev = int(fe_ev)
     # Traffic model incl. trials: each iteration ~2 X passes (counted in
     # evals) + ~4 (n,)-array reads per line-search trial × ~2 trials + the
@@ -333,39 +342,42 @@ def run_profile():
     )
 
     @jax.jit
-    def fe_only_nopallas(w0):
+    def fe_only_nopallas(w0, b):
         w, ev = w0, jnp.int32(0)
         for _ in range(CD_PASSES):
-            res = minimize_lbfgs_margin(fe_obj_nopallas, fe_batch, w, fe_cfg)
+            res = minimize_lbfgs_margin(fe_obj_nopallas, b, w, fe_cfg)
             w, ev = res.w, ev + res.evals
         return w, ev
     results["fe_only_nopallas_s"] = timeit(
         fe_only_nopallas,
-        lambda r: (jnp.full((D_FIX,), 1e-4 * (r + 1), jnp.float32),),
+        lambda r: (jnp.full((D_FIX,), 1e-4 * (r + 1), jnp.float32), fe_batch),
     )
 
     # RE phase alone: CD_PASSES vmapped Newton solves.
     offs0 = block.gather_offsets(jnp.zeros((N,), jnp.float32))
 
     @jax.jit
-    def re_only(coefs0):
+    def re_only(coefs0, blk, offs):
         coefs, vis = coefs0, jnp.int32(0)
         for _ in range(CD_PASSES):
             def solve_one(feat, lab, wt, off, w_init):
                 lb = LabeledBatch(lab, feat, off, wt)
                 res = minimize_newton(re_obj, lb, w_init, re_cfg)
                 return res.w, res.evals
-            w0 = coefs[block.entity_idx]
+            w0 = coefs[blk.entity_idx]
             w_new, evs = jax.vmap(solve_one)(
-                block.features, block.label, block.weight, offs0, w0
+                blk.features, blk.label, blk.weight, offs, w0
             )
-            coefs = coefs.at[block.entity_idx].set(w_new)
+            coefs = coefs.at[blk.entity_idx].set(w_new)
             vis = vis + jnp.sum(
-                evs * jnp.sum((block.weight > 0).astype(jnp.int32), axis=1)
+                evs * jnp.sum((blk.weight > 0).astype(jnp.int32), axis=1)
             )
         return coefs, vis
-    t = timeit(re_only, lambda r: (jnp.full((E, D_RE), 1e-4 * (r + 1), jnp.float32),))
-    _, re_vis = re_only(jnp.full((E, D_RE), 1e-4, jnp.float32))
+    t = timeit(
+        re_only,
+        lambda r: (jnp.full((E, D_RE), 1e-4 * (r + 1), jnp.float32), block, offs0),
+    )
+    _, re_vis = re_only(jnp.full((E, D_RE), 1e-4, jnp.float32), block, offs0)
     re_vis = int(re_vis)
     # Traffic model: visits already count feature passes sample-by-sample
     # (evals × n_e); each Newton iteration additionally runs a 7-point trial
@@ -385,17 +397,21 @@ def run_profile():
     step = glmix_train_step(fe_obj, re_obj, fe_cfg, re_cfg, re_solver="newton")
 
     @jax.jit
-    def full(w0, coefs0):
+    def full(w0, coefs0, b, blk, Xr_a, users_a):
         w, coefs = w0, coefs0
         fe_e = jnp.int32(0); re_v = jnp.int32(0); scores = None
         for _ in range(CD_PASSES):
-            w, coefs, scores, e, v = step(w, coefs, fe_batch, block, Xr_j, users_j)
+            w, coefs, scores, e, v = step(w, coefs, b, blk, Xr_a, users_a)
             fe_e, re_v = fe_e + e, re_v + v
         return jnp.sum(scores), fe_e, re_v
     def full_args(r):
         return (
             jnp.full((D_FIX,), 1e-4 * (r + 1), jnp.float32),
             jnp.full((E, D_RE), 1e-4 * (r + 1), jnp.float32),
+            fe_batch,
+            block,
+            Xr_j,
+            users_j,
         )
     if trace_dir:
         full(*full_args(98))  # compile before tracing

@@ -250,23 +250,25 @@ def run_tron_linear() -> dict:
     obj = GLMObjective(loss=SquaredLoss, l2_weight=1.0, intercept_index=0)
     cfg = OptimizerConfig(max_iter=15, tol=1e-5, track_history=False)
 
+    # ``b`` rides as a jit argument: closing over it would bake the ~2 GB
+    # design matrix into the HLO as a literal (slow lowering + transfer).
     @jax.jit
-    def solve(w0):
+    def solve(w0, b):
         res = minimize_tron(
-            lambda w: obj.value_and_grad(w, batch),
-            lambda w, v: obj.hvp(w, v, batch),
+            lambda w: obj.value_and_grad(w, b),
+            lambda w, v: obj.hvp(w, v, b),
             w0,
             cfg,
         )
         return res.w, res.evals
 
     _progress("config 2: compiling + warm-up")
-    w, ev = solve(jnp.zeros(_TRON_D, jnp.float32))
+    w, ev = solve(jnp.zeros(_TRON_D, jnp.float32), batch)
     float(jnp.sum(w))
     times = []
     for rep in range(3):
         t0 = time.perf_counter()
-        w, ev = solve(jnp.full((_TRON_D,), 1e-6 * (rep + 1), jnp.float32))
+        w, ev = solve(jnp.full((_TRON_D,), 1e-6 * (rep + 1), jnp.float32), batch)
         float(jnp.sum(w))
         times.append(time.perf_counter() - t0)
     dt = min(times)
@@ -361,20 +363,21 @@ def run_poisson_owlqn() -> dict:
     cfg = OptimizerConfig(max_iter=60, track_history=False)
     l1_mask = jnp.ones(_PO_D, jnp.float32).at[0].set(0.0)
 
+    # ``b`` as a jit argument, not a closure capture (see run_tron_linear).
     @jax.jit
-    def solve(w0):
+    def solve(w0, b):
         res = minimize_owlqn(
-            lambda w: obj.value_and_grad(w, batch), w0, _PO_L1, cfg, l1_mask=l1_mask
+            lambda w: obj.value_and_grad(w, b), w0, _PO_L1, cfg, l1_mask=l1_mask
         )
         return res.w, res.evals
 
     _progress("config 3: compiling + warm-up")
-    w, ev = solve(jnp.zeros(_PO_D, jnp.float32))
+    w, ev = solve(jnp.zeros(_PO_D, jnp.float32), batch)
     float(jnp.sum(w))
     times = []
     for rep in range(3):
         t0 = time.perf_counter()
-        w, ev = solve(jnp.full((_PO_D,), 1e-6 * (rep + 1), jnp.float32))
+        w, ev = solve(jnp.full((_PO_D,), 1e-6 * (rep + 1), jnp.float32), batch)
         float(jnp.sum(w))
         times.append(time.perf_counter() - t0)
     dt = min(times)
@@ -508,22 +511,25 @@ def run_sparse_wide() -> dict:
             idx_dev, vals_bf16, _SP_D, csc_order, csc_segments
         ),
     }
+    # One jitted solve shared by all variants, with the batch as a traced
+    # argument — a per-variant closure would bake ~0.5 GB of indices/values
+    # into each variant's HLO as literals.
+    @jax.jit
+    def solve(w0, b):
+        res = minimize_lbfgs_margin(obj, b, w0, cfg)
+        return res.w, res.evals
+
     for variant, feats in variants.items():
         batch = LabeledBatch(y_dev, feats)
         jax.block_until_ready(batch.features.values)
 
-        @jax.jit
-        def solve(w0, batch=batch):
-            res = minimize_lbfgs_margin(obj, batch, w0, cfg)
-            return res.w, res.evals
-
         _progress(f"config 6: compiling + warm-up ({variant})")
-        w, ev = solve(jnp.zeros(_SP_D, jnp.float32))
+        w, ev = solve(jnp.zeros(_SP_D, jnp.float32), batch)
         float(jnp.sum(w))
         times = []
         for rep in range(3):
             t0 = time.perf_counter()
-            w, ev = solve(jnp.full((_SP_D,), 1e-6 * (rep + 1), jnp.float32))
+            w, ev = solve(jnp.full((_SP_D,), 1e-6 * (rep + 1), jnp.float32), batch)
             float(jnp.sum(w))
             times.append(time.perf_counter() - t0)
         variant_walls[f"rmatvec_{variant}_wall_s"] = round(min(times), 4)
