@@ -5,6 +5,10 @@
 # One TPU process at a time; probes use the documented timeout-probe recipe
 # (project memory: axon-tpu-tunnel-fragility).
 cd /root/repo
+# Single-instance lock: two watchers passing the pgrep guard in its
+# check-then-act window would double-launch packs onto the fragile tunnel.
+exec 9>/root/repo/.tunnel_watch.lock
+flock -n 9 || { echo "another watcher holds the lock - exiting"; exit 0; }
 PACK=BENCH_PACK_r04.jsonl
 pack_complete() {
   python - "$PACK" << 'PYEOF'
@@ -25,6 +29,26 @@ sys.exit(0 if len(clean) >= need else 1)
 PYEOF
 }
 for i in $(seq 1 70); do
+  # A pack process already holds the tunnel: wait it out WITHOUT burning
+  # the probe budget, and notice if it completed the evidence itself.
+  # Bounded: a pre-watchdog pack wedged in the C++ retry loop never exits;
+  # after ~1h of waiting, fall through and let the probe budget tick so the
+  # watcher eventually gives up loudly instead of spinning forever.
+  waits=0
+  while pgrep -f "bench.py --pack" >/dev/null 2>&1 && [ "$waits" -lt 7 ]; do
+    echo "$(date +%T) pack already running - waiting ($waits)"
+    waits=$((waits + 1))
+    sleep 540
+  done
+  if pgrep -f "bench.py --pack" >/dev/null 2>&1; then
+    echo "$(date +%T) foreign pack still alive after $waits waits - probe budget ticks (probe $i)"
+    sleep 540
+    continue
+  fi
+  if pack_complete; then
+    echo "$(date +%T) pack COMPLETE (captured by another run)"
+    exit 0
+  fi
   if timeout 120 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
     echo "$(date +%T) tunnel healthy - starting/resuming bench pack (probe $i)"
     python -u bench.py --pack "$PACK" --trace-dir /root/repo/artifacts/trace_r04 >> /root/repo/bench_pack_r04.log 2>&1

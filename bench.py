@@ -575,21 +575,30 @@ def measure_cpu_baseline():
     return sps
 
 
-def _error_line(metric: str, exc: Exception) -> dict:
-    """Machine-readable failure artifact (VERDICT r3 weak #2): a wedged
-    backend or mid-run crash must still yield a parseable JSON line."""
-    msg = str(exc)
-    kind = "backend-init" if (
-        "initialize backend" in msg or "UNAVAILABLE" in msg
-    ) else type(exc).__name__
+def _artifact_line(metric: str, kind: str, detail: str) -> dict:
+    """The one shape every failure artifact uses (error lines, stall
+    watchdog, backend-init watchdog) — keep the schema in one place."""
     return {
         "metric": metric,
         "value": None,
         "unit": None,
         "vs_baseline": None,
         "error": kind,
-        "detail": msg[:300],
+        "detail": detail[:300],
     }
+
+
+def _error_line(metric: str, exc: Exception) -> dict:
+    """Machine-readable failure artifact (VERDICT r3 weak #2): a wedged
+    backend or mid-run crash must still yield a parseable JSON line."""
+    msg = str(exc)
+    if "remote_compile" in msg:
+        kind = "remote-compile"
+    elif "initialize backend" in msg or "UNAVAILABLE" in msg:
+        kind = "backend-init"
+    else:
+        kind = type(exc).__name__
+    return _artifact_line(metric, kind, msg)
 
 
 def run_pack(out_path: str) -> None:
@@ -598,10 +607,22 @@ def run_pack(out_path: str) -> None:
     section's JSON line is appended to ``out_path`` AND printed as soon as
     it completes, so a mid-run wedge still leaves earlier evidence.
     Re-running against an existing file RESUMES: sections that already
-    captured a clean (error-free) line are skipped."""
+    captured a clean (error-free) line are skipped.
+
+    A mid-session tunnel death leaves device transfers blocked inside the
+    client's C++ retry loop forever (observed: profile data-put hung >30
+    min after the relay died) — a Python-level exception never surfaces.
+    Each section therefore runs under a stall watchdog: on breach it
+    appends a machine-readable ``section-stall`` line and hard-exits so
+    the retry loop (``.tunnel_watch.sh``) can resume once the tunnel
+    heals. The limit is generous (default 30 min; ``PACK_SECTION_LIMIT_S``
+    overrides) — a healthy section compiles+runs in well under half that."""
     import os
+    import threading
 
     import bench_configs as bc
+
+    limit_s = int(os.environ.get("PACK_SECTION_LIMIT_S", "1800"))
 
     captured = set()
     if os.path.exists(out_path):
@@ -628,10 +649,31 @@ def run_pack(out_path: str) -> None:
             _progress(f"pack: {metric} already captured — skipping")
             continue
         _progress(f"pack: {metric}")
+        section_done = threading.Event()
+
+        def stall(metric=metric, done=section_done):
+            if done.is_set():  # section finished just as the timer fired
+                return
+            line = json.dumps(_artifact_line(
+                metric, "section-stall",
+                f"section exceeded {limit_s}s "
+                "(tunnel died mid-session?); hard exit for resume",
+            ))
+            with open(out_path, "a") as f:
+                f.write(line + "\n")
+            print(line, flush=True)
+            os._exit(4)
+
+        timer = threading.Timer(limit_s, stall)
+        timer.daemon = True
+        timer.start()
         try:
             r = fn()
         except Exception as exc:  # noqa: BLE001 — keep capturing evidence
             r = _error_line(metric, exc)
+        finally:
+            section_done.set()
+            timer.cancel()
         with open(out_path, "a") as f:
             f.write(json.dumps(r) + "\n")
         if r.get("metric") != "glmix_profile_phase_split" or "error" in r:
@@ -652,15 +694,11 @@ def _backend_watchdog(seconds: int = 240) -> None:
 
     def watch():
         if not done.wait(seconds):
-            print(json.dumps({
-                "metric": "glmix_logistic_samples_per_sec_per_chip",
-                "value": None,
-                "unit": None,
-                "vs_baseline": None,
-                "error": "backend-init-timeout",
-                "detail": f"jax backend init exceeded {seconds}s "
-                          "(wedged axon tunnel)",
-            }), flush=True)
+            print(json.dumps(_artifact_line(
+                "glmix_logistic_samples_per_sec_per_chip",
+                "backend-init-timeout",
+                f"jax backend init exceeded {seconds}s (wedged axon tunnel)",
+            )), flush=True)
             os._exit(3)
 
     threading.Thread(target=watch, daemon=True).start()

@@ -1,0 +1,69 @@
+"""Render BENCH_PACK_*.jsonl into the BENCH_FULL.md results table.
+
+Reads the newest clean line per metric (later lines win, error lines only
+when nothing clean exists) and prints a markdown table plus the profile
+phase-split summary — paste-ready for the evidence ledger.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+ROWS = [
+    ("glmix_logistic_samples_per_sec_per_chip", "headline GLMix (dense d=256)"),
+    ("libsvm_logistic_sweep_samples_per_sec_per_chip", "1: a9a logistic λ-sweep"),
+    ("tron_linear_l2_samples_per_sec_per_chip", "2: TRON linear + L2"),
+    ("poisson_elastic_net_samples_per_sec_per_chip", "3: Poisson elastic-net OWL-QN"),
+    ("sparse_wide_logistic_samples_per_sec_per_chip", "6: sparse wide 2^20×2^20×64nnz"),
+    ("game_bayes_tuning_wall_clock", "5: GAME + Bayes tune (8 rounds)"),
+]
+PROFILE_METRIC = "glmix_profile_phase_split"
+
+
+def main(path: str) -> None:
+    best: dict[str, dict] = {}
+    for line in open(path):
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        m = r.get("metric")
+        if not m:
+            continue
+        if "error" not in r or m not in best:
+            best[m] = r
+
+    print("| Config | Metric | TPU value | vs CPU baseline |")
+    print("|---|---|---|---|")
+    for metric, label in ROWS:
+        r = best.get(metric)
+        if r is None:
+            print(f"| {label} | — | not captured | — |")
+        elif "error" in r:
+            print(f"| {label} | — | ERROR: {r['error']} | — |")
+        else:
+            unit = r.get("unit", "")
+            vs = r.get("vs_baseline")
+            vs_s = f"**{vs:.2f}×**" if isinstance(vs, (int, float)) else "—"
+            val = r.get("value")
+            val_s = f"{val:,.0f} {unit}" if isinstance(val, (int, float)) else "—"
+            print(f"| {label} | {metric} | {val_s} | {vs_s} |")
+
+    p = best.get(PROFILE_METRIC)
+    if p and "error" not in p:
+        print("\n### Profile phase split\n")
+        for k in sorted(p):
+            if k in ("metric", "unit", "value", "vs_baseline"):
+                continue
+            v = p[k]
+            if isinstance(v, float):
+                v = round(v, 5)
+            print(f"- `{k}`: {v}")
+
+
+if __name__ == "__main__":
+    try:
+        main(sys.argv[1] if len(sys.argv) > 1 else "BENCH_PACK_r04.jsonl")
+    except BrokenPipeError:
+        pass
