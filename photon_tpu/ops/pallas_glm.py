@@ -41,9 +41,14 @@ from photon_tpu.ops.losses import PointwiseLoss
 
 Array = jax.Array
 
-# Row-tile height. 512 rows × 2048 features × 4B = 4 MB of VMEM for the X
-# tile — comfortably within the ~16 MB budget alongside w and accumulators.
-DEFAULT_TILE_N = 512
+# Requested row-tile height; the VMEM budget below is the real constraint
+# (tile_cap), so this just needs to be "large". Grid steps run sequentially
+# and carry fixed per-step cost (DMA semaphores, loop bookkeeping) — with
+# 512-row tiles on the n=2^21, d=256 headline that cost dominated: 4096
+# steps × ~1 µs ≈ 4 ms against a 1.25 ms pure-streaming pass, measured as
+# FE traffic stuck at ~5% of HBM peak (BENCH_r02). Big tiles amortize it:
+# at d=256/bf16 the budget admits 8192-row tiles = 256 steps.
+DEFAULT_TILE_N = 8192
 # Feature dims above this exceed the VMEM tile budget; callers fall back.
 MAX_FUSED_DIM = 4096
 
@@ -89,6 +94,28 @@ def _kernel(loss: PointwiseLoss, w_ref, x_ref, y_ref, off_ref, wt_ref,
     )
 
 
+def _tile_geometry(n: int, d_pad: int, dtype, tile_n: int) -> Tuple[int, int]:
+    """Choose (tile_n, n_pad) for an (n, d_pad) matrix of ``dtype``.
+
+    Constraints, in order: the X tile fits a fixed VMEM budget (Pallas
+    double-buffers grid inputs, so effective footprint is ~2×); the tile is
+    never taller than the data; and tile heights are REBALANCED across the
+    resulting grid so padding never exceeds one sublane row per tile — a
+    tall default must not round n=8200 up to two full 8192 tiles (that
+    would nearly double the HBM traffic this kernel exists to minimize).
+    """
+    sublane = 16 if dtype == jnp.bfloat16 else 8
+    budget = 4 * 1024 * 1024
+    tile_cap = budget // (d_pad * jnp.dtype(dtype).itemsize)
+    n_cap = int(np.ceil(max(n, 1) / sublane) * sublane)
+    tile_n = max(sublane, min(tile_n, (tile_cap // sublane) * sublane, n_cap))
+    # Rebalance: same tile count, evenly-sized tiles.
+    n_tiles = int(np.ceil(max(n, 1) / tile_n))
+    tile_n = int(np.ceil(np.ceil(max(n, 1) / n_tiles) / sublane) * sublane)
+    n_pad = n_tiles * tile_n
+    return tile_n, n_pad
+
+
 def fused_data_value_and_grad(
     loss: PointwiseLoss,
     w: Array,
@@ -120,13 +147,7 @@ def fused_data_value_and_grad(
         interpret = jax.default_backend() != "tpu"
 
     d_pad = int(np.ceil(max(d, 1) / 128) * 128)
-    # Keep the X tile within a fixed VMEM budget regardless of dtype/width
-    # (Pallas double-buffers grid inputs, so the effective footprint is ~2×).
-    sublane = 16 if X.dtype == jnp.bfloat16 else 8
-    budget = 4 * 1024 * 1024
-    tile_cap = budget // (d_pad * X.dtype.itemsize)
-    tile_n = max(sublane, min(tile_n, (tile_cap // sublane) * sublane))
-    n_pad = int(np.ceil(max(n, 1) / tile_n) * tile_n)
+    tile_n, n_pad = _tile_geometry(n, d_pad, X.dtype, tile_n)
     if n_pad != n or d_pad != d:
         X = jnp.pad(X, ((0, n_pad - n), (0, d_pad - d)))
         label = jnp.pad(label, (0, n_pad - n))

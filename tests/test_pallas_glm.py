@@ -46,6 +46,68 @@ def test_fused_matches_autodiff(loss, poisson):
     np.testing.assert_allclose(np.asarray(grad), np.asarray(grad_ref), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("tile_n", [8, 64, 4096])
+def test_fused_tile_height_invariance(tile_n):
+    """Identical results at any tile height, including tile_n > n (the
+    n-cap clamps it) and the big default (grid-step amortization)."""
+    n, d = 200, 24
+    X, y, weight, offset, w = _problem(n, d, seed=7)
+    val, grad = fused_data_value_and_grad(
+        LogisticLoss, jnp.asarray(w), jnp.asarray(X), jnp.asarray(y),
+        jnp.asarray(offset), jnp.asarray(weight), tile_n=tile_n,
+    )
+    obj = GLMObjective(loss=LogisticLoss)
+    batch = LabeledBatch(
+        jnp.asarray(y), jnp.asarray(X), jnp.asarray(offset), jnp.asarray(weight)
+    )
+    val_ref, grad_ref = jax.value_and_grad(obj.value)(jnp.asarray(w), batch)
+    np.testing.assert_allclose(float(val), float(val_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(grad_ref), rtol=1e-4, atol=1e-5)
+
+
+def test_tile_geometry():
+    """The tall default must never cost real padding: tile height clamps
+    to the data, rebalances across the grid, and respects the VMEM cap."""
+    from photon_tpu.ops import pallas_glm
+    from photon_tpu.ops.pallas_glm import DEFAULT_TILE_N, _tile_geometry
+
+    assert DEFAULT_TILE_N >= 4096  # the default really is tall
+
+    # Small batch: one sublane-padded tile, NOT one 8192-row tile.
+    t, npad = _tile_geometry(100, 128, jnp.float32, DEFAULT_TILE_N)
+    assert t == 104 and npad == 104
+
+    # n just past a tile multiple: rebalanced, padding ≤ sublane per tile
+    # (the un-rebalanced geometry would pad 8200 → 16384).
+    t, npad = _tile_geometry(8200, 128, jnp.float32, DEFAULT_TILE_N)
+    n_tiles = npad // t
+    assert npad - 8200 <= n_tiles * 8, (t, npad)
+    assert npad < 8200 + 2 * 8192 - 8192, npad
+
+    # VMEM cap binds at wide d: tile*d_pad*itemsize stays within budget.
+    for dtype, sublane in [(jnp.float32, 8), (jnp.bfloat16, 16)]:
+        for d_pad in [128, 256, 2048, 4096]:
+            t, npad = _tile_geometry(1 << 21, d_pad, dtype, DEFAULT_TILE_N)
+            assert t * d_pad * jnp.dtype(dtype).itemsize <= 4 * 1024 * 1024
+            assert t % sublane == 0 and npad % t == 0
+            assert npad - (1 << 21) <= (npad // t) * sublane
+
+    # Numerical parity at a rebalanced odd size spanning several tiles.
+    n, d = 1030, 8
+    X, y, weight, offset, w = _problem(n, d, seed=11)
+    val, grad = fused_data_value_and_grad(
+        LogisticLoss, jnp.asarray(w), jnp.asarray(X), jnp.asarray(y),
+        jnp.asarray(offset), jnp.asarray(weight), tile_n=512,
+    )
+    obj = GLMObjective(loss=LogisticLoss)
+    batch = LabeledBatch(
+        jnp.asarray(y), jnp.asarray(X), jnp.asarray(offset), jnp.asarray(weight)
+    )
+    val_ref, grad_ref = jax.value_and_grad(obj.value)(jnp.asarray(w), batch)
+    np.testing.assert_allclose(float(val), float(val_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(grad_ref), rtol=1e-4, atol=1e-5)
+
+
 def test_objective_dispatch_parity():
     """use_pallas=True objective == plain objective (L2 + scale norm folded)."""
     n, d = 64, 10
