@@ -256,9 +256,10 @@ def run_tron_linear() -> dict:
     def solve(w0, b):
         res = minimize_tron(
             lambda w: obj.value_and_grad(w, b),
-            lambda w, v: obj.hvp(w, v, b),
+            None,
             w0,
             cfg,
+            hvp_factory=lambda w: obj.linearized_hvp(w, b),
         )
         return res.w, res.evals
 

@@ -145,10 +145,14 @@ def _solve_block(
                 v, g = objective.value_and_grad(w * fmask, lb)
                 return v, g * fmask
 
-            hvp = lambda w, v: fmask * objective.hvp(w * fmask, fmask * v, lb)
+            def hvp_factory(w):
+                hv = objective.linearized_hvp(w * fmask, lb)
+                return lambda v: fmask * hv(fmask * v)
         else:
             vg = lambda w: objective.value_and_grad(w, lb)
-            hvp = lambda w, v: objective.hvp(w, v, lb)
+
+            def hvp_factory(w):
+                return objective.linearized_hvp(w, lb)
 
         if objective.l1_weight > 0.0:
             l1_mask = None
@@ -158,7 +162,10 @@ def _solve_block(
         elif use_newton:
             res = minimize_newton(objective, lb, w_start, config)
         elif spec.optimizer == OptimizerType.TRON:
-            res = minimize_tron(vg, hvp, w_start, config, spec.max_cg_iter)
+            res = minimize_tron(
+                vg, None, w_start, config, spec.max_cg_iter,
+                hvp_factory=hvp_factory,
+            )
         elif feature_mask is not None and (
             objective.normalization is not None
             and objective.normalization.shifts is not None

@@ -152,6 +152,36 @@ class GLMObjective:
         no Hessian materialization (reference HessianVectorAggregator.scala)."""
         return jax.jvp(lambda u: self.grad(u, batch), (w,), (v,))[1]
 
+    def linearized_hvp(self, w: Array, batch: LabeledBatch):
+        """Build ``v -> H(w)·v`` with all w-dependent state computed ONCE.
+
+        The GLM Hessian at fixed ``w`` is H = Aᵀ·diag(d2)·A + λ·mask, where
+        A = ∂margins/∂w is CONSTANT (margins is affine in w, normalization
+        folding included) and d2 = weight·loss''(z, y) depends on w only
+        through the margins z. The jvp-of-grad form recomputes z and the
+        gradient inside every product (~4 X passes); here z/d2 are cached
+        so each product is exactly one forward and one transpose pass —
+        the same per-outer-iteration caching the reference's
+        HessianVectorAggregator gets from broadcasting the fixed
+        coefficients once per CG solve (HessianVectorAggregator.scala).
+        Inner solvers (TRON's truncated CG) should prefer this via
+        ``minimize_tron(hvp_factory=...)``.
+        """
+        mfun = lambda ww: self.margins(ww, batch)  # noqa: E731
+        z, lin = jax.linearize(mfun, w)
+        # Transpose of the (already-linear) tangent map — no second forward
+        # evaluation of the margins, unlike jax.vjp(mfun, w).
+        lin_t = jax.linear_transpose(lin, w)
+        d2 = batch.weight * self.loss.dzz(z, batch.label)
+
+        def hv(v: Array) -> Array:
+            out = lin_t(d2 * lin(v))[0]
+            if self.l2_weight != 0.0:
+                out = out + self.l2_weight * self._l2_mask(v)
+            return out
+
+        return hv
+
     # ----- TwiceDiffFunction.hessianDiagonal -----
 
     def hessian_diagonal(self, w: Array, batch: LabeledBatch) -> Array:

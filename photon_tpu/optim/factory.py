@@ -70,8 +70,12 @@ def make_optimizer(
                 l1_mask = jnp.ones_like(w0).at[objective.intercept_index].set(0.0)
             return minimize_owlqn(vg, w0, objective.l1_weight, config, l1_mask)
         if spec.optimizer == OptimizerType.TRON:
-            hvp = lambda w, v: objective.hvp(w, v, batch)
-            return minimize_tron(vg, hvp, w0, config, spec.max_cg_iter, spec.box)
+            # Factory form: margins/curvature built once per outer iteration,
+            # shared across that iteration's CG products (2 X passes each).
+            return minimize_tron(
+                vg, None, w0, config, spec.max_cg_iter, spec.box,
+                hvp_factory=lambda w: objective.linearized_hvp(w, batch),
+            )
         if spec.optimizer == OptimizerType.LBFGSB:
             assert spec.box is not None, "LBFGSB requires a box"
             return minimize_lbfgsb(vg, w0, spec.box[0], spec.box[1], config)

@@ -97,20 +97,30 @@ def _truncated_cg(
 
 def minimize_tron(
     value_and_grad: ValueAndGrad,
-    hvp: Hvp,
+    hvp: Optional[Hvp],
     w0: Array,
     config: OptimizerConfig = TRON_DEFAULT_CONFIG,
     max_cg_iter: int = 20,
     box: Optional[Tuple[Array, Array]] = None,
+    hvp_factory: Optional[Callable[[Array], Callable[[Array], Array]]] = None,
 ) -> OptimizeResult:
     """Trust-region Newton minimization.
 
     Args:
       value_and_grad: w -> (f, ∇f).
-      hvp: (w, v) -> H(w)·v.
+      hvp: (w, v) -> H(w)·v. May be None when ``hvp_factory`` is given.
       box: optional coefficient box, applied by projection per accepted step
         (reference applies OptimizationUtils projection each iteration).
+      hvp_factory: w -> (v -> H(w)·v). Preferred over ``hvp``: built ONCE
+        per outer iteration, so w-dependent state (margins, curvature
+        multipliers) is shared across all ≤max_cg_iter CG products of that
+        iteration instead of recomputed inside each one
+        (GLMObjective.linearized_hvp halves the X traffic this way).
     """
+    if hvp_factory is None:
+        if hvp is None:
+            raise ValueError("minimize_tron needs hvp or hvp_factory")
+        hvp_factory = lambda w: (lambda v: hvp(w, v))  # noqa: E731
     max_iter, tol = config.max_iter, config.tol
     dtype = w0.dtype
 
@@ -135,14 +145,15 @@ def minimize_tron(
         w, f, g, delta = st["w"], st["f"], st["g"], st["delta"]
         gnorm = jnp.linalg.norm(g)
         cg_tol = 0.1 * gnorm
-        s, _hit, cg_iters = _truncated_cg(lambda v: hvp(w, v), g, delta, max_cg_iter, cg_tol)
+        hv = hvp_factory(w)  # one build per outer iteration
+        s, _hit, cg_iters = _truncated_cg(hv, g, delta, max_cg_iter, cg_tol)
 
         w_trial = project_to_box(w + s, box)
         s_eff = w_trial - w
         f_trial, g_trial = value_and_grad(w_trial)
 
         # Predicted reduction from the quadratic model (on the effective step).
-        Hs = hvp(w, s_eff)
+        Hs = hv(s_eff)
         pred = -(jnp.dot(g, s_eff) + 0.5 * jnp.dot(s_eff, Hs))
         actual = f - f_trial
         rho = actual / jnp.maximum(pred, 1e-30)

@@ -261,3 +261,75 @@ def test_newton_dead_column_no_l2():
     assert np.isfinite(w).all()
     assert w[2] == 0.0  # dead direction untouched
     np.testing.assert_allclose(w, np.asarray(ref.w), rtol=2e-3, atol=2e-3)
+
+
+def test_solve_block_tron_masked_and_unmasked():
+    """The RE TRON branch (linearized hvp_factory) must match explicit
+    per-entity TRON with the (w, v) jvp-of-grad hvp — masked (Pearson M·H·M
+    sandwich) and unmasked. Guards the factory rewrite of _solve_block."""
+    from photon_tpu.algorithm import random_effect as re_mod
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.optim.factory import OptimizerSpec
+    from photon_tpu.optim.tron import minimize_tron
+    from photon_tpu.types import OptimizerType
+
+    rng = np.random.default_rng(41)
+    N, E, d = 600, 12, 5
+    Xr = rng.normal(size=(N, d)).astype(np.float32)
+    Xr[:, 0] = 1.0
+    users = rng.integers(0, E, size=N).astype(np.int32)
+    y = (rng.uniform(size=N) < 0.5).astype(np.float32)
+    ds = build_random_effect_dataset(
+        users, Xr, y, np.ones(N, np.float32), E,
+        RandomEffectDataConfig(re_type="u", feature_shard="re", n_buckets=1),
+    )
+    (block,) = ds.blocks
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.8, intercept_index=0)
+    cfg = OptimizerConfig(max_iter=25, tol=1e-8, track_history=False)
+    offs = block.gather_offsets(jnp.zeros(N, jnp.float32))
+    w0 = jnp.zeros((block.num_entities, d), jnp.float32)
+    spec = OptimizerSpec(optimizer=OptimizerType.TRON)
+
+    # Pearson-style mask: knock out a different column per entity (never
+    # the intercept), plus some entities fully unmasked.
+    mask = np.ones((block.num_entities, d), np.float32)
+    for e in range(block.num_entities // 2):
+        mask[e, 1 + (e % (d - 1))] = 0.0
+    mask_j = jnp.asarray(mask)
+
+    for fmask_arg in (None, mask_j):
+        w_block, _, _ = re_mod._solve_block(
+            block, offs, w0, obj, spec, cfg, feature_mask=fmask_arg
+        )
+
+        def solve_ref(feat, lab, wt, off, w_init, fm):
+            lb = LabeledBatch(lab, feat, off, wt)
+
+            def vg(w):
+                v, g = obj.value_and_grad(w * fm, lb)
+                return v, g * fm
+
+            hvp = lambda w, v: fm * obj.hvp(w * fm, fm * v, lb)  # noqa: E731
+            res = minimize_tron(vg, hvp, w_init, cfg, spec.max_cg_iter)
+            return res.w * fm
+
+        fm_all = (
+            jnp.ones((block.num_entities, d), jnp.float32)
+            if fmask_arg is None
+            else fmask_arg
+        )
+        w_ref = jax.vmap(solve_ref)(
+            block.features, block.label, block.weight, offs, w0, fm_all
+        )
+        # Cross-form tolerance: the two hvp forms round differently in f32,
+        # so CG trajectories drift slightly (same bar as the other
+        # cross-solver comparisons in this file).
+        np.testing.assert_allclose(
+            np.asarray(w_block), np.asarray(w_ref), rtol=2e-3, atol=5e-4
+        )
+        if fmask_arg is not None:
+            # Masked coordinates must be exactly zero in the output.
+            assert np.all(np.asarray(w_block)[mask == 0.0] == 0.0)

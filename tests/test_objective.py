@@ -223,3 +223,92 @@ def test_sparse_bf16_values_accumulate_gradient_in_f32():
     np.testing.assert_allclose(
         np.asarray(g_bf_seg), np.asarray(g_bf_scatter), rtol=1e-5, atol=1e-5
     )
+
+
+def test_linearized_hvp_matches_jvp_hvp():
+    """linearized_hvp == jvp-of-grad hvp across losses, L2, normalization,
+    sparse features — the cached-margin form must be the same operator."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from photon_tpu.data.normalization import NormalizationContext
+    from photon_tpu.ops.losses import LogisticLoss, PoissonLoss, SquaredLoss
+    from photon_tpu.ops.objective import GLMObjective
+
+    rng = np.random.default_rng(3)
+    n, d = 120, 9
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    wt = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    off = rng.normal(size=n).astype(np.float32) * 0.1
+    w = rng.normal(size=d).astype(np.float32) * 0.3
+    v = rng.normal(size=d).astype(np.float32)
+
+    norm = NormalizationContext(
+        factors=jnp.asarray(np.linspace(0.5, 1.5, d).astype(np.float32)),
+        shifts=jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.2),
+        intercept_index=0,
+    )
+    dense = LabeledBatch(jnp.asarray(y), jnp.asarray(X), jnp.asarray(off), jnp.asarray(wt))
+
+    k = 4
+    idx = np.stack([rng.choice(d, size=k, replace=False) for _ in range(n)]).astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    sp_feats = SparseFeatures(jnp.asarray(idx), jnp.asarray(vals), d)
+    sparse = LabeledBatch(jnp.asarray(y), sp_feats, jnp.asarray(off), jnp.asarray(wt))
+
+    cases = [
+        (GLMObjective(loss=LogisticLoss), dense),
+        (GLMObjective(loss=SquaredLoss, l2_weight=0.7, intercept_index=0), dense),
+        (GLMObjective(loss=PoissonLoss, l2_weight=0.3, normalization=norm,
+                      intercept_index=0), dense),
+        (GLMObjective(loss=LogisticLoss, l2_weight=0.5, intercept_index=0), sparse),
+    ]
+    for obj, batch in cases:
+        ref = obj.hvp(jnp.asarray(w), jnp.asarray(v), batch)
+        got = obj.linearized_hvp(jnp.asarray(w), batch)(jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    # And inside jit (the TRON call path), including reuse across two v's.
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.5, intercept_index=0)
+
+    @jax.jit
+    def two_products(w, v1, v2, b):
+        hv = obj.linearized_hvp(w, b)
+        return hv(v1), hv(v2)
+
+    g1, g2 = two_products(jnp.asarray(w), jnp.asarray(v), jnp.asarray(2 * v), dense)
+    np.testing.assert_allclose(np.asarray(g2), 2 * np.asarray(g1), rtol=1e-5)
+
+
+def test_tron_factory_form_matches_plain_hvp():
+    """minimize_tron(hvp_factory=...) reaches the same optimum as the
+    (w, v) hvp form on a convex problem."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import LabeledBatch
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.common import OptimizerConfig
+    from photon_tpu.optim.tron import minimize_tron
+
+    rng = np.random.default_rng(5)
+    n, d = 400, 12
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[:, 0] = 1.0
+    wstar = rng.normal(size=d).astype(np.float32)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ wstar)))).astype(np.float32)
+    batch = LabeledBatch(jnp.asarray(y), jnp.asarray(X))
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0, intercept_index=0)
+    cfg = OptimizerConfig(max_iter=25, tol=1e-9, track_history=False)
+    vg = lambda w: obj.value_and_grad(w, batch)
+    res_a = minimize_tron(vg, lambda w, v: obj.hvp(w, v, batch),
+                          jnp.zeros(d, jnp.float32), cfg)
+    res_b = minimize_tron(vg, None, jnp.zeros(d, jnp.float32), cfg,
+                          hvp_factory=lambda w: obj.linearized_hvp(w, batch))
+    np.testing.assert_allclose(np.asarray(res_b.w), np.asarray(res_a.w),
+                               rtol=1e-4, atol=1e-5)
+    assert float(res_b.value) <= float(res_a.value) * (1 + 1e-5)
