@@ -271,12 +271,13 @@ def _read_block_varint(f) -> Optional[int]:
     return (acc >> 1) ^ -(acc & 1)
 
 
-def stream_blocks(path: str):
-    """(schema, generator of (count, decompressed bytes)) — reads the file
-    incrementally so host memory stays bounded by ONE block, not the file
-    (the round-3 reader slurped the whole container and materialized every
-    decompressed block; reference streams per-partition,
-    AvroDataReader.scala:165-209).
+def stream_raw_blocks(path: str):
+    """(schema, codec, generator of (count, COMPRESSED bytes)) — reads the
+    file incrementally so host memory stays bounded by ONE block, not the
+    file (the round-3 reader slurped the whole container and materialized
+    every decompressed block; reference streams per-partition,
+    AvroDataReader.scala:165-209). Decompression is left to the consumer
+    so parallel decoders can decompress off the reader's thread.
 
     The header parse opens/closes the file immediately; the generator
     reopens it lazily on first consumption — an UNSTARTED generator holds
@@ -297,12 +298,26 @@ def stream_blocks(path: str):
                 data = f.read(size)
                 if len(data) != size:
                     raise ValueError("truncated container block")
-                block = (
-                    zlib.decompress(data, -15) if codec == "deflate" else data
-                )
                 if f.read(SYNC_SIZE) != sync:
                     raise ValueError("bad sync marker (corrupt file)")
-                yield count, block
+                yield count, data
+
+    return schema, codec, gen()
+
+
+def _inflate(codec: str, data: bytes) -> bytes:
+    """Undo a container block's codec (the avro writer's inverse)."""
+    return zlib.decompress(data, -15) if codec == "deflate" else data
+
+
+def stream_blocks(path: str):
+    """(schema, generator of (count, decompressed bytes)): the
+    decompressed-block view of ``stream_raw_blocks`` (same laziness)."""
+    schema, codec, raw = stream_raw_blocks(path)
+
+    def gen():
+        for count, data in raw:
+            yield count, _inflate(codec, data)
 
     return schema, gen()
 
@@ -355,24 +370,25 @@ def _extract_columns(lib, ctx, program, names) -> ColumnarRows:
 
 
 def _compile_for_paths(paths: Sequence[str]):
-    """(program, names, list of per-path block generators) or None when any
-    schema falls outside the supported program / schemas differ."""
+    """(program, names, list of per-path (codec, raw-block generator)) or
+    None when any schema falls outside the supported program / schemas
+    differ."""
     program = names = None
     gens = []
     for path in paths:
-        schema, gen = stream_blocks(path)
+        schema, codec, gen = stream_raw_blocks(path)
         compiled = compile_program(schema)
         if compiled is None or (
             program is not None
             and (compiled[0] != program or compiled[1] != names)
         ):
             gen.close()
-            for g in gens:
+            for _c, g in gens:
                 g.close()
             return None
         if program is None:
             program, names = compiled
-        gens.append(gen)
+        gens.append((codec, gen))
     return program, names, gens
 
 
@@ -391,25 +407,116 @@ def read_avro_columnar(paths: Sequence[str]) -> Optional[ColumnarRows]:
 
     ctx = lib.avro_dec_new(program, len(program))
     try:
-        for gen in gens:
+        for codec, gen in gens:
             for count, data in gen:
+                data = _inflate(codec, data)
                 rc = lib.avro_dec_block(ctx, data, len(data), count)
                 if rc != 0:
                     return None  # malformed vs program: Python-codec fallback
         return _extract_columns(lib, ctx, program, names)
     finally:
         lib.avro_dec_free(ctx)
-        for g in gens:
+        for _c, g in gens:
             g.close()
 
 
-def stream_avro_columnar(paths: Sequence[str], chunk_rows: int = 1 << 16):
+def _available_cores() -> int:
+    """Cores available to THIS process (cgroup/affinity-aware where the
+    platform supports it; sched_getaffinity is Linux-only)."""
+    getaff = getattr(os, "sched_getaffinity", None)
+    if getaff is not None:
+        try:
+            return max(1, len(getaff(0)))
+        except OSError:  # pragma: no cover - exotic platforms
+            pass
+    return max(1, os.cpu_count() or 1)
+
+
+def merge_columnar(parts: Sequence[ColumnarRows]) -> ColumnarRows:
+    """Concatenate per-block/per-chunk ColumnarRows into one, re-interning
+    strings into a single table (first-occurrence order over parts, which
+    matches what a serial decode of the same blocks would produce)."""
+    if len(parts) == 1:
+        return parts[0]
+    table: Dict[str, int] = {}
+    intern: List[str] = []
+    luts = []
+    for p in parts:
+        lut = np.empty(len(p.intern) + 1, np.int32)  # [-1] slot for nulls
+        lut[-1] = -1
+        for i, s in enumerate(p.intern):
+            idx = table.get(s)
+            if idx is None:
+                idx = len(intern)
+                table[s] = idx
+                intern.append(s)
+            lut[i] = idx
+        luts.append(lut)
+
+    n = sum(p.n for p in parts)
+    row_off = np.cumsum([0] + [p.n for p in parts])
+    numeric = {
+        k: np.concatenate([p.numeric[k] for p in parts])
+        for k in parts[0].numeric
+    }
+    longs = {
+        k: np.concatenate([p.longs[k] for p in parts]) for k in parts[0].longs
+    }
+    strings = {
+        k: np.concatenate([lut[p.strings[k]] for p, lut in zip(parts, luts)])
+        for k in parts[0].strings
+    }
+    bags = {}
+    for k in parts[0].bags:
+        offs_parts, keys_parts, vals_parts = [], [], []
+        nnz_off = 0
+        for p, lut in zip(parts, luts):
+            b = p.bags[k]
+            offs_parts.append(
+                (b.offsets if nnz_off == 0 else b.offsets[1:]) + nnz_off
+            )
+            keys_parts.append(lut[b.key_ids])
+            vals_parts.append(b.values)
+            nnz_off += int(b.offsets[-1])
+        bags[k] = FeatureBagColumn(
+            offsets=np.concatenate(offs_parts),
+            key_ids=np.concatenate(keys_parts),
+            values=np.concatenate(vals_parts),
+        )
+    meta_rows = np.concatenate([
+        p.meta_rows + np.int32(row_off[i]) for i, p in enumerate(parts)
+    ])
+    meta_keys = np.concatenate([
+        lut[p.meta_keys] for p, lut in zip(parts, luts)
+    ])
+    meta_vals = np.concatenate([
+        lut[p.meta_vals] for p, lut in zip(parts, luts)
+    ])
+    return ColumnarRows(
+        n=n, numeric=numeric, longs=longs, strings=strings, bags=bags,
+        meta_rows=meta_rows, meta_keys=meta_keys, meta_vals=meta_vals,
+        intern=intern,
+    )
+
+
+def stream_avro_columnar(
+    paths: Sequence[str],
+    chunk_rows: int = 1 << 16,
+    workers: Optional[int] = None,
+):
     """Yield ColumnarRows chunks of >= chunk_rows rows (block-aligned):
     the streaming ingest path (SURVEY §7 hard part 4, VERDICT r3 #5). Host
-    memory is bounded by one chunk + one decompressed block, never the
-    file. Raises (rather than returning None) when the native decoder or
-    schema can't serve the stream — streaming callers need a hard error,
-    not a silent slurp."""
+    memory is bounded by one chunk + a bounded window of in-flight blocks,
+    never the file. Raises (rather than returning None) when the native
+    decoder or schema can't serve the stream — streaming callers need a
+    hard error, not a silent slurp.
+
+    ``workers`` > 1 decodes container blocks CONCURRENTLY — zlib and the
+    native decoder both release the GIL, and blocks are independent (the
+    Spark-partition analogue, AvroDataReader.scala:165-209), so decode
+    scales with cores while results are merged back in file order
+    (bit-identical to the serial path, parity-tested). Default: one worker
+    per available core."""
     lib = _load_lib()
     if lib is None:
         raise RuntimeError("native decoder unavailable for streaming ingest")
@@ -420,20 +527,82 @@ def stream_avro_columnar(paths: Sequence[str], chunk_rows: int = 1 << 16):
             "schemas); streaming ingest unavailable"
         )
     program, names, gens = compiled
-    ctx = lib.avro_dec_new(program, len(program))
-    try:
-        for gen in gens:
+    if workers is None:
+        workers = min(16, _available_cores())
+
+    def decode_one(codec: str, count: int, data: bytes) -> ColumnarRows:
+        data = _inflate(codec, data)
+        ctx = lib.avro_dec_new(program, len(program))
+        try:
+            rc = lib.avro_dec_block(ctx, data, len(data), count)
+            if rc != 0:
+                raise ValueError("malformed container block")
+            return _extract_columns(lib, ctx, program, names)
+        finally:
+            lib.avro_dec_free(ctx)
+
+    def blocks():
+        for codec, gen in gens:
             for count, data in gen:
-                rc = lib.avro_dec_block(ctx, data, len(data), count)
-                if rc != 0:
-                    raise ValueError("malformed container block")
-                if int(lib.avro_dec_num_records(ctx)) >= chunk_rows:
+                yield codec, count, data
+
+    try:
+        if workers <= 1:
+            # Serial: one long-lived ctx accumulates blocks per chunk (no
+            # merge cost, identical output).
+            ctx = lib.avro_dec_new(program, len(program))
+            try:
+                for codec, count, data in blocks():
+                    data = _inflate(codec, data)
+                    rc = lib.avro_dec_block(ctx, data, len(data), count)
+                    if rc != 0:
+                        raise ValueError("malformed container block")
+                    if int(lib.avro_dec_num_records(ctx)) >= chunk_rows:
+                        yield _extract_columns(lib, ctx, program, names)
+                        lib.avro_dec_free(ctx)
+                        ctx = lib.avro_dec_new(program, len(program))
+                if int(lib.avro_dec_num_records(ctx)) > 0:
                     yield _extract_columns(lib, ctx, program, names)
-                    lib.avro_dec_free(ctx)
-                    ctx = lib.avro_dec_new(program, len(program))
-        if int(lib.avro_dec_num_records(ctx)) > 0:
-            yield _extract_columns(lib, ctx, program, names)
+            finally:
+                lib.avro_dec_free(ctx)
+            return
+
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(max_workers=workers)
+        try:
+            pending = collections.deque()  # futures in FILE ORDER
+            buffered: List[ColumnarRows] = []
+            buffered_rows = 0
+            source = blocks()
+
+            def drain(fut):
+                nonlocal buffered_rows
+                part = fut.result()
+                buffered.append(part)
+                buffered_rows += part.n
+
+            exhausted = False
+            while not exhausted or pending:
+                while not exhausted and len(pending) < 2 * workers:
+                    try:
+                        codec, count, data = next(source)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending.append(pool.submit(decode_one, codec, count, data))
+                if pending:
+                    drain(pending.popleft())
+                if buffered_rows >= chunk_rows:
+                    yield merge_columnar(buffered)
+                    buffered, buffered_rows = [], 0
+            if buffered:
+                yield merge_columnar(buffered)
+        finally:
+            # An abandoned generator or a decode error must not block on
+            # (or waste) the ~2*workers queued read-ahead blocks.
+            pool.shutdown(wait=True, cancel_futures=True)
     finally:
-        lib.avro_dec_free(ctx)
-        for g in gens:
+        for _c, g in gens:
             g.close()

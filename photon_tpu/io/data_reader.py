@@ -431,21 +431,26 @@ def stream_merged(
     intern_new_entities: bool = True,
     chunk_rows: int = 1 << 16,
     column_names: Optional[InputColumnsNames] = None,
+    workers: Optional[int] = None,
 ):
     """Chunked readMerged: yields GameBatch chunks with host memory bounded
-    by one chunk (+ one decompressed block), never the dataset — each chunk's
-    arrays are device-put-able as soon as it is yielded, so ingest overlaps
-    the host->device feed (SURVEY §7 hard part 4; the reference streams
-    per-partition, AvroDataReader.scala:165-209).
+    by one chunk (+ a bounded window of in-flight blocks), never the
+    dataset — each chunk's arrays are device-put-able as soon as it is
+    yielded, so ingest overlaps the host->device feed (SURVEY §7 hard part
+    4; the reference streams per-partition, AvroDataReader.scala:165-209).
 
     ``index_maps`` must be supplied: a stream cannot be distinct-scanned
     first (use the feature-indexing driver or a prior read). Entity ids
     intern cumulatively across chunks through ``entity_indexes``.
+    ``workers`` caps the concurrent block decode (default: one per
+    available core; 1 forces the serial single-ctx path).
     """
     from photon_tpu.io.columnar import stream_avro_columnar
 
     entity_indexes = entity_indexes if entity_indexes is not None else {}
-    for cols in stream_avro_columnar(_expand_paths(paths), chunk_rows):
+    for cols in stream_avro_columnar(
+        _expand_paths(paths), chunk_rows, workers=workers
+    ):
         batch, entity_indexes = _columnar_to_game_batch(
             cols, shard_configs, index_maps, entity_id_columns,
             entity_indexes, intern_new_entities, column_names,

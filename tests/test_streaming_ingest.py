@@ -152,3 +152,44 @@ def test_corrupt_container_never_crashes_the_process(tmp_path):
             outcomes["raised"] += 1
     # Sanity: the harness saw both clean-ish decodes and rejections.
     assert outcomes["raised"] > 0, outcomes
+
+
+@native_available
+@pytest.mark.parametrize("chunk_rows", [64, 300, 10_000])
+def test_parallel_stream_bit_identical_to_serial(tmp_path, chunk_rows):
+    """workers>1 decodes blocks concurrently but must produce chunks
+    BIT-IDENTICAL to the serial path: same boundaries, same intern order,
+    same CSR layout (the merge preserves file order)."""
+    path = tmp_path / "par.avro"
+    _write(path, n=1200, block_rows=53)
+    serial = list(stream_avro_columnar([str(path)], chunk_rows=chunk_rows, workers=1))
+    parallel = list(stream_avro_columnar([str(path)], chunk_rows=chunk_rows, workers=4))
+    assert len(serial) == len(parallel)
+    for s, p in zip(serial, parallel):
+        assert s.n == p.n
+        assert s.intern == p.intern
+        for k in s.numeric:
+            np.testing.assert_array_equal(s.numeric[k], p.numeric[k])
+        for k in s.longs:
+            np.testing.assert_array_equal(s.longs[k], p.longs[k])
+        for k in s.strings:
+            np.testing.assert_array_equal(s.strings[k], p.strings[k])
+        for k in s.bags:
+            np.testing.assert_array_equal(s.bags[k].offsets, p.bags[k].offsets)
+            np.testing.assert_array_equal(s.bags[k].key_ids, p.bags[k].key_ids)
+            np.testing.assert_array_equal(s.bags[k].values, p.bags[k].values)
+        np.testing.assert_array_equal(s.meta_rows, p.meta_rows)
+        np.testing.assert_array_equal(s.meta_keys, p.meta_keys)
+        np.testing.assert_array_equal(s.meta_vals, p.meta_vals)
+
+
+@native_available
+def test_parallel_stream_malformed_block_raises(tmp_path):
+    """A corrupt block must fail loudly on the parallel path too."""
+    path = tmp_path / "bad.avro"
+    _write(path, n=300, block_rows=50)
+    raw = bytearray(path.read_bytes())
+    raw[-40] ^= 0xFF  # flip a byte inside the last block's payload
+    path.write_bytes(bytes(raw))
+    with pytest.raises(Exception):
+        list(stream_avro_columnar([str(path)], chunk_rows=64, workers=4))
