@@ -54,7 +54,20 @@ for i in $(seq 1 70); do
     python -u bench.py --pack "$PACK" --trace-dir /root/repo/artifacts/trace_r04 >> /root/repo/bench_pack_r04.log 2>&1
     echo "$(date +%T) pack attempt rc=$?"
     if pack_complete; then
-      echo "$(date +%T) pack COMPLETE"
+      echo "$(date +%T) pack COMPLETE - refreshing headline on current kernel"
+      # One extra headline line on the post-session-1 kernel (tall tiles,
+      # linearized HVPs). timeout guards the run-phase hang a dying tunnel
+      # causes (backend-init watchdog only covers init); the line is
+      # appended ONLY on success so a failed refresh can't append an error
+      # record to an already-complete pack.
+      out=$(timeout 900 python -u bench.py 2>/dev/null)
+      rc=$?
+      if [ $rc -eq 0 ]; then
+        printf '%s\n' "$out" | tail -1 >> "$PACK"
+        echo "$(date +%T) headline refresh appended"
+      else
+        echo "$(date +%T) headline refresh failed rc=$rc (pack already complete - fine)"
+      fi
       exit 0
     fi
   else

@@ -247,7 +247,11 @@ def run_tron_linear() -> dict:
     X, y = _linear_data()
     batch = LabeledBatch(jnp.asarray(y), jnp.asarray(X))
     jax.block_until_ready(batch.features)
-    obj = GLMObjective(loss=SquaredLoss, l2_weight=1.0, intercept_index=0)
+    # use_pallas: value/grad rides the fused one-pass kernel and each CG
+    # product the fused one-pass HVP (fused_data_hvp via linearized_hvp).
+    obj = GLMObjective(
+        loss=SquaredLoss, l2_weight=1.0, intercept_index=0, use_pallas=True
+    )
     cfg = OptimizerConfig(max_iter=15, tol=1e-5, track_history=False)
 
     # ``b`` rides as a jit argument: closing over it would bake the ~2 GB

@@ -166,7 +166,29 @@ class GLMObjective:
         coefficients once per CG solve (HessianVectorAggregator.scala).
         Inner solvers (TRON's truncated CG) should prefer this via
         ``minimize_tron(hvp_factory=...)``.
+
+        With ``use_pallas`` (and a fusible batch) each product runs the
+        one-pass fused kernel (ops.pallas_glm.fused_data_hvp): forward and
+        transpose matvec share a single HBM read of each X tile.
         """
+        if self._can_fuse(batch):
+            from photon_tpu.ops.pallas_glm import fused_data_hvp
+
+            z = self.margins(w, batch)
+            d2 = batch.weight * self.loss.dzz(z, batch.label)
+            f = None if self.normalization is None else self.normalization.factors
+
+            def hv_fused(v: Array) -> Array:
+                ev = v if f is None else v * f
+                out = fused_data_hvp(ev, batch.features, d2)
+                if f is not None:
+                    out = out * f
+                if self.l2_weight != 0.0:
+                    out = out + self.l2_weight * self._l2_mask(v)
+                return out.astype(v.dtype)
+
+            return hv_fused
+
         mfun = lambda ww: self.margins(ww, batch)  # noqa: E731
         z, lin = jax.linearize(mfun, w)
         # Transpose of the (already-linear) tangent map — no second forward

@@ -94,6 +94,68 @@ def _kernel(loss: PointwiseLoss, w_ref, x_ref, y_ref, off_ref, wt_ref,
     )
 
 
+def _hvp_kernel(v_ref, x_ref, d2_ref, out_ref):
+    """One-pass GLM data-Hessian product: per row tile,
+    u = X_tile·v (MXU), then out += X_tileᵀ·(d2 ∘ u) (MXU) — the tile is
+    read from HBM once for both dots. d2 = weight·loss''(z, y) is
+    precomputed by the caller at the current outer iterate."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = x_ref[:]
+    u = jnp.dot(x, v_ref[:], preferred_element_type=jnp.float32)
+    t = d2_ref[:] * u
+    out_ref[:] += jax.lax.dot_general(
+        x, t,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def fused_data_hvp(
+    v: Array,
+    X: Array,
+    d2: Array,
+    tile_n: int = DEFAULT_TILE_N,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Xᵀ·diag(d2)·X·v in ONE pass over ``X`` (vs two XLA passes for the
+    forward and transpose matvecs). The data term of a GLM Hessian-vector
+    product at fixed margins; pairs with GLMObjective.linearized_hvp,
+    which caches d2 once per outer iteration
+    (HessianVectorAggregator.scala role). Padding is exact (zero rows /
+    columns contribute nothing)."""
+    n, d = X.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d_pad = int(np.ceil(max(d, 1) / 128) * 128)
+    tile_n, n_pad = _tile_geometry(n, d_pad, X.dtype, tile_n)
+    if n_pad != n or d_pad != d:
+        X = jnp.pad(X, ((0, n_pad - n), (0, d_pad - d)))
+        d2 = jnp.pad(d2, (0, n_pad - n))
+        v = jnp.pad(v, (0, d_pad - d))
+    v2 = v.astype(X.dtype)[:, None]
+    d2c = d2.astype(jnp.float32)[:, None]
+    n_tiles = n_pad // tile_n
+    out = pl.pallas_call(
+        _hvp_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),       # v
+            pl.BlockSpec((tile_n, d_pad), lambda i: (i, 0)),  # X row tile
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),      # d2
+        ],
+        out_specs=pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((d_pad, 1), jnp.float32),
+        interpret=interpret,
+    )(v2, X, d2c)
+    hv = out[:, 0]
+    return hv[:d] if d_pad != d else hv
+
+
 def _tile_geometry(n: int, d_pad: int, dtype, tile_n: int) -> Tuple[int, int]:
     """Choose (tile_n, n_pad) for an (n, d_pad) matrix of ``dtype``.
 
