@@ -277,7 +277,11 @@ def run_tron_linear() -> dict:
         float(jnp.sum(w))
         times.append(time.perf_counter() - t0)
     dt = min(times)
-    visits = 2 * _TRON_N * int(ev)  # each f/g or H·v eval ≈ 2 X passes
+    # NOMINAL algorithmic visits — each f/g or H·v eval = 2 visits/sample
+    # (value+grad, forward+transpose), the same accounting the scipy
+    # trust-ncg baseline uses; the fused kernels serve each pair in one
+    # physical X pass, which is the win vs_baseline measures.
+    visits = 2 * _TRON_N * int(ev)
     sps = visits / dt
     fp = workload_fp("tron_linear", _TRON_N, _TRON_D, 15, 1e-5, 1)
     return dict(
@@ -389,7 +393,11 @@ def run_poisson_owlqn() -> dict:
         float(jnp.sum(w))
         times.append(time.perf_counter() - t0)
     dt = min(times)
-    visits = 2 * _PO_N * int(ev)  # black-box evals: 2 X passes each
+    # NOMINAL algorithmic visits — value+grad = 2 visits/sample per eval,
+    # the same accounting the scipy CPU baseline uses. The fused kernel
+    # serves both in ONE physical X pass; that implementation win is what
+    # vs_baseline measures, so the work normalization must not change.
+    visits = 2 * _PO_N * int(ev)
     sps = visits / dt
     nnz = int(jnp.sum(jnp.abs(w) > 1e-8))
     fp = workload_fp("poisson_owlqn", _PO_N, _PO_D, _PO_L1, _PO_L2, 60, 2)

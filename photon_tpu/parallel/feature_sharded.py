@@ -70,6 +70,31 @@ def _check_objective(objective: GLMObjective) -> None:
         )
 
 
+def _local_window(indices, values, shard, factors_loc):
+    """Per-device view of globally-indexed sparse rows: indices mapped into
+    this shard's coefficient range, values factor-folded, validity mask
+    applied. The ONE place the sharding-critical window math lives — the
+    gradient and Hessian paths must stay byte-for-byte consistent."""
+    lo = jax.lax.axis_index(FEATURE_AXIS) * shard
+    local_idx = indices - lo
+    valid = (local_idx >= 0) & (local_idx < shard)
+    local_idx = jnp.clip(local_idx, 0, shard - 1)
+    vals = values
+    if factors_loc is not None:
+        vals = vals * jnp.where(valid, factors_loc[local_idx], 0.0)
+    return local_idx, valid, vals
+
+
+def _l2_masked_local(x_loc, shard, intercept):
+    """Local shard of x with the (globally-indexed) intercept zeroed."""
+    xm = x_loc.astype(jnp.float32)
+    if intercept is not None:
+        lo = jax.lax.axis_index(FEATURE_AXIS) * shard
+        pos = jnp.arange(shard) + lo
+        xm = jnp.where(pos == intercept, 0.0, xm)
+    return xm
+
+
 def sparse_value_and_grad_feature_sharded(
     objective: GLMObjective, mesh: Mesh, dim: int
 ):
@@ -92,15 +117,7 @@ def sparse_value_and_grad_feature_sharded(
 
     def local_fn(w_loc, indices, values, label, offset, weight, factors_loc):
         """Runs per device: w_loc (shard,), rows local along data."""
-        lo = jax.lax.axis_index(FEATURE_AXIS) * shard
-        local_idx = indices - lo
-        valid = (local_idx >= 0) & (local_idx < shard)
-        local_idx = jnp.clip(local_idx, 0, shard - 1)
-
-        vals = values
-        if factors_loc is not None:
-            f_gather = jnp.where(valid, factors_loc[local_idx], 0.0)
-            vals = vals * f_gather
+        local_idx, valid, vals = _local_window(indices, values, shard, factors_loc)
 
         # All accumulation in float32 regardless of the feature-value dtype
         # (bf16 values would otherwise degrade the margins, the gradient,
@@ -125,10 +142,7 @@ def sparse_value_and_grad_feature_sharded(
 
         # L2 on the local shard; the (global) intercept is exempt.
         if l2 != 0.0:
-            wm = w_loc.astype(jnp.float32)
-            if intercept is not None:
-                pos = jnp.arange(shard) + lo
-                wm = jnp.where(pos == intercept, 0.0, wm)
+            wm = _l2_masked_local(w_loc, shard, intercept)
             grad_loc = grad_loc + l2 * wm
             l2_local = 0.5 * l2 * jnp.sum(wm * wm)
         else:
@@ -168,6 +182,89 @@ def sparse_value_and_grad_feature_sharded(
     return value_and_grad
 
 
+def sparse_linearized_hvp_feature_sharded(
+    objective: GLMObjective, mesh: Mesh, dim: int
+):
+    """Build ``make_hvp(w, batch) -> (v -> H(w)·v)`` with ``w``/``v``
+    feature-sharded and rows data-sharded — the distributed counterpart of
+    GLMObjective.linearized_hvp (reference: the distributed objective's
+    hessianVector treeAggregate, HessianVectorAggregator.scala, one round
+    per CG product). Curvature d2 = weight·loss''(z,y) is computed ONCE per
+    outer iterate (one sharded margins pass, psum over ``feature``); each
+    product is then one forward + one scatter-add transpose pass with a
+    psum over ``feature`` (for u) and one over ``data`` (for the result) —
+    both on ICI.
+    """
+    _check_objective(objective)
+    n_feat = mesh.shape[FEATURE_AXIS]
+    dp = dp_axes(mesh)
+    assert dim % n_feat == 0, f"dim {dim} not divisible by feature axis {n_feat}"
+    shard = dim // n_feat
+    loss = objective.loss
+    l2 = objective.l2_weight
+    intercept = objective.intercept_index
+    factors = None if objective.normalization is None else objective.normalization.factors
+
+    def local_d2(w_loc, indices, values, label, offset, weight, factors_loc):
+        local_idx, valid, vals = _local_window(indices, values, shard, factors_loc)
+        gathered = jnp.where(valid, w_loc[local_idx], 0.0)
+        z_partial = jnp.sum((vals * gathered).astype(jnp.float32), axis=-1)
+        z = jax.lax.psum(z_partial, FEATURE_AXIS) + offset
+        return weight * loss.dzz(z, label)
+
+    def local_hv(v_loc, indices, values, d2, factors_loc):
+        local_idx, valid, vals = _local_window(indices, values, shard, factors_loc)
+        v_gather = jnp.where(valid, v_loc[local_idx], 0.0)
+        u_partial = jnp.sum((vals * v_gather).astype(jnp.float32), axis=-1)
+        u = jax.lax.psum(u_partial, FEATURE_AXIS)  # (A·v) on each data shard
+        t = d2 * u
+        contrib = jnp.where(valid, vals * t[:, None], 0.0).astype(jnp.float32)
+        hv_loc = jnp.zeros((shard,), jnp.float32).at[
+            local_idx.reshape(-1)
+        ].add(contrib.reshape(-1))
+        hv_loc = jax.lax.psum(hv_loc, dp)
+        if l2 != 0.0:
+            hv_loc = hv_loc + l2 * _l2_masked_local(v_loc, shard, intercept)
+        return hv_loc
+
+    row_specs = (P(dp, None), P(dp, None))  # indices, values
+    factor_spec = (P(FEATURE_AXIS),) if factors is not None else ()
+    d2_shmapped = jax.shard_map(
+        (lambda w, i, v, y, o, wt, f: local_d2(w, i, v, y, o, wt, f))
+        if factors is not None
+        else (lambda w, i, v, y, o, wt: local_d2(w, i, v, y, o, wt, None)),
+        mesh=mesh,
+        in_specs=(P(FEATURE_AXIS),) + row_specs + (P(dp), P(dp), P(dp)) + factor_spec,
+        out_specs=P(dp),
+    )
+    hv_shmapped = jax.shard_map(
+        (lambda v, i, vl, d2, f: local_hv(v, i, vl, d2, f))
+        if factors is not None
+        else (lambda v, i, vl, d2: local_hv(v, i, vl, d2, None)),
+        mesh=mesh,
+        in_specs=(P(FEATURE_AXIS),) + row_specs + (P(dp),) + factor_spec,
+        out_specs=P(FEATURE_AXIS),
+    )
+
+    def make_hvp(w: Array, batch: LabeledBatch):
+        feats = batch.features
+        assert isinstance(feats, SparseFeatures)
+        args = (w, feats.indices, feats.values, batch.label, batch.offset, batch.weight)
+        if factors is not None:
+            args = args + (factors,)
+        d2 = d2_shmapped(*args)
+
+        def hv(v: Array) -> Array:
+            hv_args = (v, feats.indices, feats.values, d2)
+            if factors is not None:
+                hv_args = hv_args + (factors,)
+            return hv_shmapped(*hv_args)
+
+        return hv
+
+    return make_hvp
+
+
 def place_feature_sharded(
     mesh: Mesh, w: Array, batch: LabeledBatch
 ) -> Tuple[Array, LabeledBatch]:
@@ -196,16 +293,29 @@ def train_fixed_effect_feature_sharded(
     config: OptimizerConfig,
     dim: int,
     box: Optional[Tuple[Array, Array]] = None,
+    solver: str = "lbfgs",
+    max_cg_iter: int = 20,
 ):
-    """Jitted L-BFGS fit of a sparse fixed-effect coordinate with ``w``
+    """Jitted fit of a sparse fixed-effect coordinate with ``w``
     feature-sharded over the mesh (reference FixedEffectCoordinate.trainModel
     role, FixedEffectCoordinate.scala:115-129, for coordinates whose ``w``
     exceeds one chip's HBM).
 
+    ``solver``: ``"lbfgs"`` (default) or ``"tron"`` — TRON rides the
+    sharded linearized HVP (one psum pair per CG product, the reference's
+    distributed hessianVector).
+
     Returns ``fit(w0, batch) -> OptimizeResult`` with ``result.w`` sharded
     P('feature'). ``dim`` must be pre-padded (see ``padded_dim``).
     """
+    if solver not in ("lbfgs", "tron"):
+        raise ValueError(f"unknown feature-sharded solver {solver!r}")
     vg = sparse_value_and_grad_feature_sharded(objective, mesh, dim)
+    make_hvp = (
+        sparse_linearized_hvp_feature_sharded(objective, mesh, dim)
+        if solver == "tron"
+        else None
+    )
 
     @functools.partial(
         jax.jit,
@@ -222,6 +332,13 @@ def train_fixed_effect_feature_sharded(
         batch = LabeledBatch(
             label, SparseFeatures(indices, values, dim), offset, weight
         )
+        if solver == "tron":
+            from photon_tpu.optim.tron import minimize_tron
+
+            return minimize_tron(
+                lambda w: vg(w, batch), None, w0, config, max_cg_iter, box,
+                hvp_factory=lambda w: make_hvp(w, batch),
+            )
         return minimize_lbfgs(lambda w: vg(w, batch), w0, config, box=box)
 
     def fit_batch(w0: Array, batch: LabeledBatch) -> OptimizeResult:
