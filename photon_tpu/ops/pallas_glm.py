@@ -36,10 +36,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from photon_tpu.ops.losses import PointwiseLoss
 
 Array = jax.Array
+
+# Both kernels ACCUMULATE into their output block across grid steps, which
+# requires the row-tile grid to run sequentially. Mosaic infers that from
+# the constant output index map, but megacore parts (v4/v5p) split
+# "parallel" grid dims across cores — declare the semantics explicitly so
+# the reduction stays correct everywhere, not just on single-core v5e.
+_SEQUENTIAL_GRID = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
 
 # Requested row-tile height; the VMEM budget below is the real constraint
 # (tile_cap), so this just needs to be "large". Grid steps run sequentially
@@ -150,6 +158,7 @@ def fused_data_hvp(
         ],
         out_specs=pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((d_pad, 1), jnp.float32),
+        compiler_params=None if interpret else _SEQUENTIAL_GRID,
         interpret=interpret,
     )(v2, X, d2c)
     hv = out[:, 0]
@@ -249,6 +258,7 @@ def fused_data_value_and_grad(
         ],
         out_specs=out_specs,
         out_shape=out_shape,
+        compiler_params=None if interpret else _SEQUENTIAL_GRID,
         interpret=interpret,
     )(w2, X, col(label), col(offset), col(weight))
 
