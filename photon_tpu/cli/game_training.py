@@ -120,6 +120,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write per-feature summary statistics as "
                         "FeatureSummarizationResultAvro, one file per shard "
                         "(ModelProcessingUtils.writeBasicStatistics role)")
+    p.add_argument("--feature-index-dir", default=None,
+                   help="directory of index-map-<shard>.json files written "
+                        "by the feature-indexing driver; skips the distinct "
+                        "scan (reference offHeapIndexMapDir role) and is "
+                        "required for --stream-ingest-chunk-rows")
+    p.add_argument("--stream-ingest-chunk-rows", type=int, default=0,
+                   help="read training/validation data through the chunked "
+                        "streaming path (host memory bounded by one chunk; "
+                        "chunks assemble on the device) instead of the "
+                        "slurping reader; needs --feature-index-dir "
+                        "(a stream cannot be distinct-scanned first)")
     return p
 
 
@@ -149,11 +160,67 @@ def run(args) -> Dict:
         getattr(args, "input_column_names", None)
     )
     process_output_dir(args.output_dir, args.override_output_dir)
+
+    # Pre-built index maps (feature-indexing driver output; reference
+    # offHeapIndexMapDir role). Mandatory for streaming ingest — a stream
+    # cannot be distinct-scanned first.
+    preloaded_maps = None
+    if args.feature_index_dir:
+        preloaded_maps = {}
+        for shard in shard_configs:
+            path = os.path.join(
+                args.feature_index_dir, f"index-map-{shard}.json"
+            )
+            try:
+                preloaded_maps[shard] = IndexMap.load(path)
+            except OSError as exc:
+                raise SystemExit(
+                    f"--feature-index-dir: cannot read {path} ({exc}); "
+                    "expected index-map-<shard>.json files as written by "
+                    "the feature-indexing driver, one per configured "
+                    f"feature shard ({sorted(shard_configs)})"
+                ) from exc
+    chunk_rows = int(getattr(args, "stream_ingest_chunk_rows", 0) or 0)
+    if chunk_rows > 0 and preloaded_maps is None:
+        raise SystemExit(
+            "--stream-ingest-chunk-rows requires --feature-index-dir "
+            "(run the feature-indexing driver first)"
+        )
+
+    def read(paths, index_maps, entity_indexes, intern_new):
+        if chunk_rows > 0:
+            from photon_tpu.io.data_reader import concat_game_batches, stream_merged
+
+            eidx = entity_indexes if entity_indexes is not None else {}
+            try:
+                chunks = list(stream_merged(
+                    paths, shard_configs, index_maps,
+                    entity_id_columns=entity_id_columns, entity_indexes=eidx,
+                    intern_new_entities=intern_new, chunk_rows=chunk_rows,
+                    column_names=column_names,
+                ))
+            except (RuntimeError, ValueError) as exc:
+                # Streaming never silently slurps (the user asked for
+                # bounded host memory) — fail with actionable guidance.
+                raise SystemExit(
+                    f"streaming ingest unavailable for {paths}: {exc}; "
+                    "drop --stream-ingest-chunk-rows to use the row-codec "
+                    "fallback reader"
+                ) from exc
+            if not chunks:
+                raise SystemExit(
+                    f"streaming ingest read zero data blocks from {paths}"
+                )
+            return concat_game_batches(chunks), index_maps, eidx
+        return read_merged(
+            paths, shard_configs, index_maps=index_maps,
+            entity_id_columns=entity_id_columns, entity_indexes=entity_indexes,
+            intern_new_entities=intern_new, column_names=column_names,
+        )
+
     with Timed("driver/read-train"):
-        batch, index_maps, entity_indexes = read_merged(
-            resolve_input_paths(args), shard_configs,
-            entity_id_columns=entity_id_columns,
-            column_names=column_names,
+        batch, index_maps, entity_indexes = read(
+            resolve_input_paths(args), preloaded_maps, None, True
         )
     # Row-level sanity checks on train + validation data
     # (GameTrainingDriver.scala:415-432).
@@ -162,10 +229,8 @@ def run(args) -> Dict:
     valid_batch = None
     if args.validation_paths:
         with Timed("driver/read-validation"):
-            valid_batch, _, _ = read_merged(
-                args.validation_paths, shard_configs, index_maps=index_maps,
-                entity_id_columns=entity_id_columns, entity_indexes=entity_indexes,
-                intern_new_entities=False, column_names=column_names,
+            valid_batch, _, _ = read(
+                args.validation_paths, index_maps, entity_indexes, False
             )
         validate_game_batch(valid_batch, task, validation_mode)
 

@@ -291,3 +291,72 @@ def test_game_training_with_normalization(fixture_dir, tmp_path):
     # same quality class (the pre-fix bug scored transformed-space w on raw
     # features, cratering this).
     assert abs(norm - plain) < 0.05, aucs
+
+
+def test_game_training_streaming_ingest(fixture_dir, tmp_path):
+    """--stream-ingest-chunk-rows + --feature-index-dir: the chunked
+    host-bounded read path must train to the same result as the slurp
+    (reference offHeapIndexMapDir + per-partition read flow)."""
+    from photon_tpu.io.columnar import _load_lib
+
+    if _load_lib() is None:
+        pytest.skip("native decoder unavailable")
+
+    # Stage 1: feature indexing (writes index-map-<shard>.json).
+    idx_dir = tmp_path / "fidx"
+    fargs = feature_indexing.build_parser().parse_args(
+        [
+            "--input-paths", str(fixture_dir / "train.avro"),
+            "--output-dir", str(idx_dir),
+            "--feature-shard-configurations", "name=globalShard",
+        ]
+    )
+    feature_indexing.run(fargs)
+
+    common = [
+        "--validation-paths", str(fixture_dir / "valid.avro"),
+        "--feature-shard-configurations", "name=globalShard",
+        "--coordinate-configurations",
+        "name=global,feature.shard=globalShard,optimizer=LBFGS,reg.weights=1",
+        "name=perUser,feature.shard=globalShard,random.effect.type=userId,reg.weights=1",
+        "--update-sequence", "global,perUser",
+        "--evaluators", "AUC",
+    ]
+    out_stream = tmp_path / "out_stream"
+    sargs = game_training.build_parser().parse_args(
+        ["--input-paths", str(fixture_dir / "train.avro"),
+         "--output-dir", str(out_stream),
+         "--feature-index-dir", str(idx_dir),
+         "--stream-ingest-chunk-rows", "128"] + common
+    )
+    s_stream = game_training.run(sargs)
+
+    out_slurp = tmp_path / "out_slurp"
+    aargs = game_training.build_parser().parse_args(
+        ["--input-paths", str(fixture_dir / "train.avro"),
+         "--output-dir", str(out_slurp),
+         "--feature-index-dir", str(idx_dir)] + common
+    )
+    s_slurp = game_training.run(aargs)
+
+    # Same index maps + same data => identical training outcome.
+    assert s_stream["best"]["metrics"]["AUC"] == pytest.approx(
+        s_slurp["best"]["metrics"]["AUC"], abs=1e-6
+    )
+    assert s_stream["best"]["metrics"]["AUC"] > 0.7
+
+
+def test_stream_ingest_requires_index_dir(fixture_dir, tmp_path):
+    args = game_training.build_parser().parse_args(
+        [
+            "--input-paths", str(fixture_dir / "train.avro"),
+            "--output-dir", str(tmp_path / "o"),
+            "--feature-shard-configurations", "name=globalShard",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,reg.weights=1",
+            "--update-sequence", "global",
+            "--stream-ingest-chunk-rows", "64",
+        ]
+    )
+    with pytest.raises(SystemExit):
+        game_training.run(args)
