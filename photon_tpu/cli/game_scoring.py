@@ -13,6 +13,7 @@ import json
 import os
 from typing import Dict
 
+import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.cli.common import (
@@ -37,7 +38,55 @@ def build_parser() -> argparse.ArgumentParser:
                         "(defaults to the training output dir = parent of model dir)")
     p.add_argument("--evaluators", nargs="*", default=[])
     p.add_argument("--model-id", default="game-model")
+    p.add_argument("--stream-ingest-chunk-rows", type=int, default=0,
+                   help="score through the chunked streaming reader: host "
+                        "memory bounded by one chunk of features (scores/"
+                        "labels/ids accumulate — they are O(n) scalars); "
+                        "chunks pad to a multiple of this (sparse nnz "
+                        "widths bucket to powers of two) so the scoring "
+                        "program compiles for a handful of shapes, not one "
+                        "per chunk")
     return p
+
+
+def _pad_features(v, pad: int):
+    from photon_tpu.data.batch import SparseFeatures
+
+    if isinstance(v, SparseFeatures):
+        # Rows: zero-valued padding pointing at index 0 contributes nothing.
+        # Columns: the per-chunk nnz width varies with the densest row seen,
+        # so bucket it to the next power of two — otherwise every distinct
+        # width retraces the jitted scorer (one XLA compile per chunk).
+        k = v.indices.shape[1]
+        k_pad = 1 << max(0, (k - 1)).bit_length()
+        return SparseFeatures(
+            jnp.pad(v.indices, ((0, pad), (0, k_pad - k))),
+            jnp.pad(v.values, ((0, pad), (0, k_pad - k))),
+            v.dim,
+        )
+    return jnp.pad(v, ((0, pad), (0, 0)))
+
+
+def _pad_game_batch(b, target_n: int):
+    """Pad a GameBatch to ``target_n`` rows with weight-0 samples and -1
+    entity ids (scored as zero and dropped by the caller)."""
+    from photon_tpu.data.game_data import GameBatch
+
+    pad = target_n - b.n
+    if pad <= 0:
+        return b
+    padf = lambda a: jnp.pad(a, (0, pad))  # noqa: E731
+    return GameBatch(
+        label=padf(b.label),
+        offset=padf(b.offset),
+        weight=padf(b.weight),  # zeros: padding rows carry no weight
+        features={k: _pad_features(v, pad) for k, v in b.features.items()},
+        entity_ids={
+            k: jnp.pad(v, (0, pad), constant_values=-1)
+            for k, v in b.entity_ids.items()
+        },
+        uid=None if b.uid is None else padf(b.uid),
+    )
 
 
 def run(args) -> Dict:
@@ -71,13 +120,13 @@ def run(args) -> Dict:
     from photon_tpu.utils.io_utils import process_output_dir
 
     process_output_dir(args.output_dir, args.override_output_dir)
-    batch, _, _ = read_merged(
-        resolve_input_paths(args), shard_configs, index_maps=index_maps,
+    column_names = parse_input_column_names(
+        getattr(args, "input_column_names", None)
+    )
+    read_kwargs = dict(
         entity_id_columns={rt: rt for rt in re_types},
         entity_indexes=entity_indexes, intern_new_entities=False,
-        column_names=parse_input_column_names(
-            getattr(args, "input_column_names", None)
-        ),
+        column_names=column_names,
     )
 
     suite = None
@@ -86,23 +135,93 @@ def run(args) -> Dict:
         suite = EvaluationSuite(
             [EvaluatorSpec.parse(e) for e in args.evaluators], num_entities
         )
-    transformer = GameTransformer(model, suite)
-    scores = transformer.transform(batch)
+
+    chunk_rows = int(getattr(args, "stream_ingest_chunk_rows", 0) or 0)
+    if chunk_rows > 0:
+        # Streaming: feature chunks are read, scored, and dropped; only the
+        # O(n)-scalar columns (scores/labels/weights/uids/entity ids)
+        # accumulate. Chunks pad to a chunk_rows multiple so the jitted
+        # scoring program compiles for at most a couple of shapes.
+        from photon_tpu.data.game_data import GameBatch
+        from photon_tpu.io.data_reader import stream_merged
+
+        transformer = GameTransformer(model, None)
+        acc: Dict[str, list] = {
+            "scores": [], "label": [], "weight": [], "uid": [],
+            **{rt: [] for rt in re_types},
+        }
+        gen = stream_merged(
+            resolve_input_paths(args), shard_configs, index_maps,
+            chunk_rows=chunk_rows, **read_kwargs,
+        )
+        uid_base = 0
+        while True:
+            # Only the STREAM can be "unavailable" — scoring errors must
+            # surface as themselves, not as advice to drop the flag.
+            try:
+                chunk = next(gen)
+            except StopIteration:
+                break
+            except (RuntimeError, ValueError) as exc:
+                raise SystemExit(
+                    f"streaming ingest unavailable: {exc}; drop "
+                    "--stream-ingest-chunk-rows to use the slurping reader"
+                ) from exc
+            n = chunk.n
+            target = int(np.ceil(n / chunk_rows) * chunk_rows)
+            s = transformer.transform(_pad_game_batch(chunk, target))
+            acc["scores"].append(np.asarray(s)[:n])
+            acc["label"].append(np.asarray(chunk.label))
+            acc["weight"].append(np.asarray(chunk.weight))
+            # Per-chunk uids restart at 0; renumber globally so scores.avro
+            # matches the slurp path's UniqueSampleId sequence exactly.
+            acc["uid"].append(np.asarray(chunk.uid) + uid_base)
+            uid_base += n
+            for rt in re_types:
+                acc[rt].append(np.asarray(chunk.entity_ids[rt]))
+        if not acc["scores"]:
+            raise SystemExit("streaming ingest read zero data blocks")
+        scores = np.concatenate(acc["scores"])
+        labels = np.concatenate(acc["label"])
+        weights = np.concatenate(acc["weight"])
+        uid_arr = np.concatenate(acc["uid"])
+        metrics = None
+        if suite is not None:
+            eval_batch = GameBatch(
+                label=jnp.asarray(labels),
+                offset=jnp.zeros(len(labels), jnp.float32),
+                weight=jnp.asarray(weights),
+                features={},
+                entity_ids={rt: jnp.asarray(np.concatenate(acc[rt]))
+                            for rt in re_types},
+            )
+            metrics = suite.evaluate_scores(jnp.asarray(scores), eval_batch)
+    else:
+        batch, _, _ = read_merged(
+            resolve_input_paths(args), shard_configs, index_maps=index_maps,
+            **read_kwargs,
+        )
+        transformer = GameTransformer(model, suite)
+        scores = np.asarray(transformer.transform(batch))
+        labels = np.asarray(batch.label)
+        weights = np.asarray(batch.weight)
+        uid_arr = np.asarray(batch.uid)
+        metrics = transformer.last_metrics if suite is not None else None
 
     os.makedirs(args.output_dir, exist_ok=True)
     save_scores(
         os.path.join(args.output_dir, "scores.avro"),
-        np.asarray(scores),
+        scores,
         args.model_id,
-        uids=[str(int(u)) for u in np.asarray(batch.uid)],
-        labels=np.asarray(batch.label),
-        weights=np.asarray(batch.weight),
+        uids=[str(int(u)) for u in uid_arr],
+        labels=labels,
+        weights=weights,
     )
     out = {"numScored": int(scores.shape[0])}
-    if suite is not None:
-        out["metrics"] = transformer.last_metrics
+    if metrics is not None:
+        out["metrics"] = metrics
         with open(os.path.join(args.output_dir, "scoring-metrics.json"), "w") as f:
-            json.dump(transformer.last_metrics, f, indent=2)
+            json.dump(metrics, f, indent=2)
     return out
 
 
