@@ -15,3 +15,18 @@ force_virtual_cpu_devices(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", False)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Release compiled executables after each test module. A full-suite
+    process accumulates hundreds of XLA:CPU programs; past ~260 tests the
+    next compilation segfaulted inside backend_compile (observed twice at
+    test_variance::test_random_effect_full_variances_vmapped, which passes
+    in a fresh process). Bounding the live-executable set keeps the suite
+    one process and deterministic."""
+    yield
+    jax.clear_caches()
