@@ -49,7 +49,7 @@ for i in $(seq 1 70); do
     echo "$(date +%T) pack COMPLETE (captured by another run)"
     exit 0
   fi
-  if timeout 120 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
+  if timeout -k 10 120 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
     echo "$(date +%T) tunnel healthy - starting/resuming bench pack (probe $i)"
     python -u bench.py --pack "$PACK" --trace-dir /root/repo/artifacts/trace_r04 >> /root/repo/bench_pack_r04.log 2>&1
     echo "$(date +%T) pack attempt rc=$?"
@@ -60,7 +60,7 @@ for i in $(seq 1 70); do
       # causes (backend-init watchdog only covers init); the line is
       # appended ONLY on success so a failed refresh can't append an error
       # record to an already-complete pack.
-      out=$(timeout 900 python -u bench.py 2>/dev/null)
+      out=$(timeout -k 30 900 python -u bench.py 2>/dev/null)
       rc=$?
       if [ $rc -eq 0 ]; then
         printf '%s\n' "$out" | tail -1 >> "$PACK"
