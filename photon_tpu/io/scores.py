@@ -22,10 +22,14 @@ def save_scores(
     labels: Optional[np.ndarray] = None,
     weights: Optional[np.ndarray] = None,
 ) -> None:
-    records = []
-    for i, s in enumerate(np.asarray(scores)):
-        records.append(
-            {
+    scores = np.asarray(scores)
+
+    def records():
+        # Generator: the block writer consumes rows as produced, so the
+        # per-row record dicts never materialize all at once (a 10M-row
+        # scoring output would otherwise hold ~GBs of dicts transiently).
+        for i, s in enumerate(scores):
+            yield {
                 "uid": None if uids is None else str(uids[i]),
                 "label": None if labels is None else float(labels[i]),
                 "modelId": model_id,
@@ -33,8 +37,8 @@ def save_scores(
                 "weight": None if weights is None else float(weights[i]),
                 "metadataMap": None,
             }
-        )
-    write_avro_records(path, SCORING_RESULT_SCHEMA, records)
+
+    write_avro_records(path, SCORING_RESULT_SCHEMA, records())
 
 
 def load_scores(path: str) -> List[dict]:
