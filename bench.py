@@ -687,13 +687,18 @@ def run_pack(out_path: str) -> None:
                 if "error" not in prev and prev.get("metric"):
                     captured.add(prev["metric"])
 
+    # Order = evidence priority under a possibly-short tunnel window: the
+    # headline and a9a sweep first (the round's banner numbers), then the
+    # profile (the standing HBM-utilization question) and the sparse wide
+    # config (the billions-of-coefficients story), then the remaining
+    # configs. Resume skips whatever already captured cleanly.
     sections = [
         ("glmix_logistic_samples_per_sec_per_chip", run_glmix_bench),
         ("libsvm_logistic_sweep_samples_per_sec_per_chip", bc.run_libsvm_sweep),
+        ("glmix_profile_phase_split", run_profile),
+        ("sparse_wide_logistic_samples_per_sec_per_chip", bc.run_sparse_wide),
         ("tron_linear_l2_samples_per_sec_per_chip", bc.run_tron_linear),
         ("poisson_elastic_net_samples_per_sec_per_chip", bc.run_poisson_owlqn),
-        ("sparse_wide_logistic_samples_per_sec_per_chip", bc.run_sparse_wide),
-        ("glmix_profile_phase_split", run_profile),
         ("game_bayes_tuning_wall_clock", bc.run_game_tuning),
     ]
     for metric, fn in sections:
