@@ -59,12 +59,14 @@ def _pad_features(v, pad: int):
         # width retraces the jitted scorer (one XLA compile per chunk).
         k = v.indices.shape[1]
         k_pad = 1 << max(0, (k - 1)).bit_length()
+        if pad == 0 and k_pad == k:
+            return v  # already bucketed: no eager device copies
         return SparseFeatures(
             jnp.pad(v.indices, ((0, pad), (0, k_pad - k))),
             jnp.pad(v.values, ((0, pad), (0, k_pad - k))),
             v.dim,
         )
-    return jnp.pad(v, ((0, pad), (0, 0)))
+    return v if pad == 0 else jnp.pad(v, ((0, pad), (0, 0)))
 
 
 def _pad_game_batch(b, target_n: int):
@@ -72,19 +74,22 @@ def _pad_game_batch(b, target_n: int):
     entity ids (scored as zero and dropped by the caller)."""
     from photon_tpu.data.game_data import GameBatch
 
-    pad = target_n - b.n
-    if pad <= 0:
-        return b
-    padf = lambda a: jnp.pad(a, (0, pad))  # noqa: E731
+    pad = max(target_n - b.n, 0)
+    # pad == 0 still goes through _pad_features: the power-of-two nnz-width
+    # bucketing must apply to EVERY chunk, or a chunk landing exactly on a
+    # chunk_rows multiple keeps its raw width and retraces the jitted
+    # scorer per distinct width (ADVICE r4). Row arrays pass through
+    # untouched in that case (no no-op pads on the streaming hot path).
+    padf = (lambda a: a) if pad == 0 else (
+        lambda a: jnp.pad(a, (0, pad)))  # noqa: E731
+    pad_eid = (lambda v: v) if pad == 0 else (
+        lambda v: jnp.pad(v, (0, pad), constant_values=-1))  # noqa: E731
     return GameBatch(
         label=padf(b.label),
         offset=padf(b.offset),
         weight=padf(b.weight),  # zeros: padding rows carry no weight
         features={k: _pad_features(v, pad) for k, v in b.features.items()},
-        entity_ids={
-            k: jnp.pad(v, (0, pad), constant_values=-1)
-            for k, v in b.entity_ids.items()
-        },
+        entity_ids={k: pad_eid(v) for k, v in b.entity_ids.items()},
         uid=None if b.uid is None else padf(b.uid),
     )
 

@@ -137,6 +137,7 @@ def fused_data_hvp(
     (HessianVectorAggregator.scala role). Padding is exact (zero rows /
     columns contribute nothing)."""
     n, d = X.shape
+    _check_fused_width(d, "fused_data_hvp")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     d_pad = int(np.ceil(max(d, 1) / 128) * 128)
@@ -187,6 +188,18 @@ def _tile_geometry(n: int, d_pad: int, dtype, tile_n: int) -> Tuple[int, int]:
     return tile_n, n_pad
 
 
+def _check_fused_width(d: int, fn_name: str) -> None:
+    """Every in-tree caller is gated by GLMObjective._can_fuse; a direct
+    caller above the width limit would get a tile clamped to sublane rows,
+    blow the 4 MB VMEM budget, and die in Mosaic with an opaque compile
+    error (ADVICE r4). Fail fast and descriptively instead."""
+    if d > MAX_FUSED_DIM:
+        raise ValueError(
+            f"{fn_name} supports d <= {MAX_FUSED_DIM} (got d={d}); "
+            "use the two-pass XLA path for wider problems"
+        )
+
+
 def fused_data_value_and_grad(
     loss: PointwiseLoss,
     w: Array,
@@ -214,6 +227,7 @@ def fused_data_value_and_grad(
     every iteration instead of accumulating ``z += α·u`` rounding drift.
     """
     n, d = X.shape
+    _check_fused_width(d, "fused_data_value_and_grad")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
