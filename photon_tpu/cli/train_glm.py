@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import enum
 import json
+import logging
 import os
 from typing import Dict, List, Optional
 
@@ -24,7 +25,7 @@ from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.index_map import IndexMap
 from photon_tpu.data.normalization import build_normalization_context
 from photon_tpu.data.stats import compute_feature_stats
-from photon_tpu.evaluation.evaluators import EvaluatorType, evaluate, metric_is_better
+from photon_tpu.evaluation.metrics_map import metrics_map, selection_metric
 from photon_tpu.io.data_reader import FeatureShardConfig, read_merged
 from photon_tpu.io.libsvm import read_libsvm
 from photon_tpu.io.model_io import save_game_model
@@ -43,14 +44,6 @@ from photon_tpu.utils.events import (
     training_finish_event,
     training_start_event,
 )
-
-DEFAULT_METRIC = {
-    TaskType.LOGISTIC_REGRESSION: EvaluatorType.AUC,
-    TaskType.LINEAR_REGRESSION: EvaluatorType.RMSE,
-    TaskType.POISSON_REGRESSION: EvaluatorType.POISSON_LOSS,
-    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: EvaluatorType.AUC,
-}
-
 
 class DriverStage(enum.Enum):
     """Reference DriverStage.scala:20-55 state machine."""
@@ -229,20 +222,35 @@ def run(args) -> Dict:
         )
     stage = DriverStage.TRAINED
 
-    # Validation + model selection (Driver.modelSelection:416 role).
-    metric_type = DEFAULT_METRIC[task]
+    # Validation + model selection (Driver.computeAndLogModelMetrics:353 +
+    # Driver.modelSelection:416 roles): every λ gets the task's FULL
+    # MetricsMap (Evaluation.scala:31-128) — MAE/MSE/RMSE for regression,
+    # AUPR/AUROC/peak-F1 for classifiers, per-datum log-likelihood + AIC
+    # where defined — then the best model is picked by the task's
+    # selection metric (ModelSelection.scala:36-63).
+    log = logging.getLogger("photon_tpu.train_glm")
     best_idx = len(models) - 1
     if valid is not None:
-        better = metric_is_better(metric_type)
+        sel_name, larger_better = selection_metric(task)
         best_val = None
         for i, m in enumerate(models):
-            scores = valid.margins(m["w"])
-            if task == TaskType.LOGISTIC_REGRESSION:
-                pass  # AUC on margins is rank-equivalent
-            v = float(evaluate(metric_type, scores, valid.label, valid.weight))
-            m["validation"] = {metric_type.value: v}
-            if best_val is None or better(v, best_val):
+            margins = valid.margins(m["w"])
+            mmap = metrics_map(
+                task, margins, valid.label, coefficients=m["w"]
+            )
+            m["validation"] = mmap
+            log.info("Model with lambda = %g:", m["lambda"])
+            for name in sorted(mmap):  # Driver.scala:400-405 log shape
+                log.info("Metric: [%s] value: %s", name, mmap[name])
+            v = mmap[sel_name]
+            if best_val is None or (
+                v > best_val if larger_better else v < best_val
+            ):
                 best_val, best_idx = v, i
+        log.info(
+            "Regularization weight of the best model is: %g",
+            models[best_idx]["lambda"],
+        )
         stage = DriverStage.VALIDATED
 
     os.makedirs(args.output_dir, exist_ok=True)

@@ -129,6 +129,26 @@ def auc_pr(scores: Array, labels: Array, weight: Optional[Array] = None) -> Arra
     return jnp.sum(contrib)
 
 
+def peak_f1(scores: Array, labels: Array,
+            weight: Optional[Array] = None) -> Array:
+    """max over score thresholds t of F1(score >= t) — the reference's
+    ``BinaryClassificationMetrics.fMeasureByThreshold().map(_._2).max``
+    with every distinct score a candidate threshold; tied scores share one
+    threshold (same group-end convention as auc_pr)."""
+    w = _default_weight(scores, weight)
+    order = jnp.argsort(-scores)  # descending: threshold sweep
+    s, y, ww = scores[order], labels[order], w[order]
+    pos_w = jnp.where(y > 0, ww, 0.0)
+    tp = jnp.cumsum(pos_w)
+    pp = jnp.cumsum(ww)  # predicted-positive mass at this threshold
+    pos = jnp.sum(pos_w)
+    is_group_end = jnp.concatenate(
+        [(s[1:] != s[:-1]), jnp.ones((1,), bool)]
+    )
+    f1 = 2.0 * tp / jnp.maximum(pp + pos, 1e-30)
+    return jnp.max(jnp.where(is_group_end, f1, -jnp.inf))
+
+
 def rmse(scores: Array, labels: Array, weight: Optional[Array] = None) -> Array:
     w = _default_weight(scores, weight)
     tot = jnp.maximum(jnp.sum(w), 1e-30)

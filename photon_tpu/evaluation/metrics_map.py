@@ -1,0 +1,137 @@
+"""Per-model validation MetricsMap — the legacy driver's full metric set.
+
+Mirrors the reference's ``Evaluation.evaluate``
+(photon-client .../evaluation/Evaluation.scala:31-128): every validated
+model gets a map of metric name → value whose FACETS are selected by task —
+
+- regression tasks (linear, Poisson): mean absolute error, mean square
+  error, root mean square error over mean-function predictions
+  (Evaluation.scala:66-74);
+- binary classifiers (logistic, smoothed hinge): area under PR, area
+  under ROC, peak F1 score over score thresholds (Evaluation.scala:79-90);
+- likelihood models: per-datum log-likelihood — logistic from the mean
+  scores with EPSILON clamping (Evaluation.scala:147-160), Poisson from
+  the margins (Evaluation.scala:131-144);
+- AIC with the small-sample correction whenever a log-likelihood exists,
+  with effective parameters = #{|coeff| > 1e-9} (Evaluation.scala:104-123).
+
+Metric NAMES are the reference's exact strings so logs and serialized
+metric maps line up across frameworks. Like the reference, the map is
+UNWEIGHTED — ``Evaluation.evaluate`` ignores LabeledPoint weights (its
+RegressionMetrics/BinaryClassificationMetrics run on bare (score, label)
+pairs and ``averageLogLikelihoodRDD`` counts 1 per datum); weighted
+evaluation lives in ``evaluation.suite.EvaluationSuite``. Model selection
+per task follows ModelSelection.scala:36-63 (logistic → AUROC↑,
+linear → RMSE↓, Poisson → per-datum log-likelihood↑; smoothed hinge joins
+the BinaryClassifier rule, AUROC↑).
+
+The per-metric reductions run on device; only final scalars come back.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from photon_tpu.evaluation.evaluators import Array, auc_pr, auc_roc, peak_f1
+from photon_tpu.types import TaskType
+
+MEAN_ABSOLUTE_ERROR = "Mean absolute error"
+MEAN_SQUARE_ERROR = "Mean square error"
+ROOT_MEAN_SQUARE_ERROR = "Root mean square error"
+AREA_UNDER_PRECISION_RECALL = "Area under precision/recall"
+AREA_UNDER_ROC = "Area under ROC"
+PEAK_F1_SCORE = "Peak F1 score"
+DATA_LOG_LIKELIHOOD = "Per-datum log likelihood"
+AKAIKE_INFORMATION_CRITERION = "Akaike information criterion"
+EPSILON = 1e-9
+
+_REGRESSION_TASKS = (TaskType.LINEAR_REGRESSION, TaskType.POISSON_REGRESSION)
+_BINARY_TASKS = (
+    TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
+)
+
+
+def mean_function(task: TaskType, margins: Array) -> Array:
+    """computeMeanFunctionWithOffset: margins (x·w + offset) → predictions
+    on the label scale (GeneralizedLinearModel.scala mean-function role)."""
+    if task == TaskType.LOGISTIC_REGRESSION:
+        return 1.0 / (1.0 + jnp.exp(-margins))
+    if task == TaskType.POISSON_REGRESSION:
+        return jnp.exp(margins)
+    # Linear regression and smoothed-hinge SVM score on the margin itself.
+    return margins
+
+
+def _log_likelihood_per_datum(
+    task: TaskType, margins: Array, predictions: Array, labels: Array
+) -> Optional[Array]:
+    if task == TaskType.LOGISTIC_REGRESSION:
+        p = jnp.asarray(predictions, jnp.float32)
+        log_p = jnp.log(jnp.maximum(p, EPSILON))
+        log_1mp = jnp.where(
+            p > 1.0 - EPSILON, math.log(EPSILON), jnp.log1p(-p)
+        )
+        ll = labels * log_p + (1.0 - labels) * log_1mp
+    elif task == TaskType.POISSON_REGRESSION:
+        z = jnp.asarray(margins, jnp.float32)
+        # y·wTx − e^{wTx} − log Γ(1+y)  (Evaluation.scala:136-139)
+        ll = labels * z - jnp.exp(z) - gammaln(1.0 + labels)
+    else:
+        return None
+    return jnp.mean(ll)  # averageLogLikelihoodRDD: 1 count per datum
+
+
+def metrics_map(
+    task: TaskType,
+    margins: Array,
+    labels: Array,
+    coefficients: Optional[Array] = None,
+) -> Dict[str, float]:
+    """The reference's per-model MetricsMap, computed from the validation
+    margins (Evaluation.evaluate, Evaluation.scala:31-128)."""
+    labels = jnp.asarray(labels, jnp.float32)
+    preds = mean_function(task, jnp.asarray(margins, jnp.float32))
+    out: Dict[str, float] = {}
+
+    if task in _REGRESSION_TASKS:
+        err = preds - labels
+        mse = jnp.mean(err * err)
+        out[MEAN_ABSOLUTE_ERROR] = float(jnp.mean(jnp.abs(err)))
+        out[MEAN_SQUARE_ERROR] = float(mse)
+        out[ROOT_MEAN_SQUARE_ERROR] = float(jnp.sqrt(mse))
+
+    if task in _BINARY_TASKS:
+        out[AREA_UNDER_PRECISION_RECALL] = float(auc_pr(preds, labels))
+        out[AREA_UNDER_ROC] = float(auc_roc(preds, labels))
+        out[PEAK_F1_SCORE] = float(peak_f1(preds, labels))
+
+    ll = _log_likelihood_per_datum(task, margins, preds, labels)
+    if ll is not None:
+        ll = float(ll)
+        out[DATA_LOG_LIKELIHOOD] = ll
+        if coefficients is not None:
+            n = labels.shape[0]  # scoreAndLabel.count(): samples, unweighted
+            k = int(jnp.sum(jnp.abs(jnp.asarray(coefficients)) > 1e-9))
+            base_aic = 2.0 * (k - n * ll)
+            den = n - k - 1.0
+            # Scala doubles yield ±Infinity at den == 0 and the reference
+            # logs it harmlessly; Python float division would raise instead.
+            corr = math.inf if den == 0 else 2.0 * k * (k + 1) / den
+            out[AKAIKE_INFORMATION_CRITERION] = base_aic + corr
+    return out
+
+
+# ModelSelection.scala:36-63 — (metric name, larger_is_better) per task.
+_SELECTION: Dict[TaskType, Tuple[str, bool]] = {
+    TaskType.LOGISTIC_REGRESSION: (AREA_UNDER_ROC, True),
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: (AREA_UNDER_ROC, True),
+    TaskType.LINEAR_REGRESSION: (ROOT_MEAN_SQUARE_ERROR, False),
+    TaskType.POISSON_REGRESSION: (DATA_LOG_LIKELIHOOD, True),
+}
+
+
+def selection_metric(task: TaskType) -> Tuple[str, bool]:
+    return _SELECTION[task]

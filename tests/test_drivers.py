@@ -147,7 +147,7 @@ def test_legacy_glm_driver_libsvm(tmp_path):
     # Best model by AUC present + text model files written.
     assert any(f.startswith("model-lambda-") for f in os.listdir(out))
     assert (out / "best" / "model-metadata.json").exists()
-    aucs = [m["validation"]["AUC"] for m in summary["models"]]
+    aucs = [m["validation"]["Area under ROC"] for m in summary["models"]]
     assert max(aucs) > 0.75
 
 

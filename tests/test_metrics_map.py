@@ -1,0 +1,131 @@
+"""MetricsMap parity tests against hand-computed values.
+
+Reference semantics: Evaluation.scala:31-128 (facet selection, metric
+names, EPSILON-clamped logistic LL, Poisson LL from margins, AIC with the
+small-sample correction) and ModelSelection.scala:36-63 (per-task
+selection metric + direction).
+"""
+import math
+
+import numpy as np
+import pytest
+
+from photon_tpu.evaluation.evaluators import peak_f1
+from photon_tpu.evaluation.metrics_map import (
+    AKAIKE_INFORMATION_CRITERION,
+    AREA_UNDER_PRECISION_RECALL,
+    AREA_UNDER_ROC,
+    DATA_LOG_LIKELIHOOD,
+    MEAN_ABSOLUTE_ERROR,
+    MEAN_SQUARE_ERROR,
+    PEAK_F1_SCORE,
+    ROOT_MEAN_SQUARE_ERROR,
+    metrics_map,
+    selection_metric,
+)
+from photon_tpu.types import TaskType
+
+rng = np.random.default_rng(3)
+
+
+def test_linear_regression_facet():
+    margins = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    labels = np.array([1.5, 2.0, 2.0, 5.0], np.float32)
+    m = metrics_map(TaskType.LINEAR_REGRESSION, margins, labels)
+    err = margins - labels
+    assert m[MEAN_ABSOLUTE_ERROR] == pytest.approx(np.abs(err).mean(), rel=1e-6)
+    assert m[MEAN_SQUARE_ERROR] == pytest.approx((err ** 2).mean(), rel=1e-6)
+    assert m[ROOT_MEAN_SQUARE_ERROR] == pytest.approx(
+        math.sqrt((err ** 2).mean()), rel=1e-6
+    )
+    # Linear regression is not a likelihood model in the reference map.
+    assert DATA_LOG_LIKELIHOOD not in m
+    assert AREA_UNDER_ROC not in m
+
+
+def test_logistic_facet_and_log_likelihood():
+    margins = rng.normal(size=200).astype(np.float32)
+    labels = (rng.random(200) < 1 / (1 + np.exp(-3 * margins))).astype(np.float32)
+    w = np.array([0.5, 0.0, -2.0], np.float32)  # 2 effective params
+    m = metrics_map(TaskType.LOGISTIC_REGRESSION, margins, labels,
+                    coefficients=w)
+    assert MEAN_ABSOLUTE_ERROR not in m  # classifier: no regression facet
+    assert 0.5 < m[AREA_UNDER_ROC] <= 1.0
+    assert 0.0 < m[AREA_UNDER_PRECISION_RECALL] <= 1.0
+    p = 1 / (1 + np.exp(-margins))
+    ll = float(np.mean(labels * np.log(np.maximum(p, 1e-9))
+                       + (1 - labels) * np.log1p(-np.minimum(p, 1 - 1e-9))))
+    assert m[DATA_LOG_LIKELIHOOD] == pytest.approx(ll, rel=1e-4)
+    # AIC: 2(k − n·ll) + 2k(k+1)/(n−k−1) with k = #|w|>1e-9 = 2.
+    n, k = 200.0, 2
+    aic = 2 * (k - n * ll) + 2 * k * (k + 1) / (n - k - 1)
+    assert m[AKAIKE_INFORMATION_CRITERION] == pytest.approx(aic, rel=1e-4)
+
+
+def test_poisson_log_likelihood_from_margins():
+    margins = np.array([0.0, 0.5, -0.3], np.float32)
+    labels = np.array([1.0, 3.0, 0.0], np.float32)
+    m = metrics_map(TaskType.POISSON_REGRESSION, margins, labels)
+    ll_each = labels * margins - np.exp(margins) - [
+        math.lgamma(1 + y) for y in labels
+    ]
+    assert m[DATA_LOG_LIKELIHOOD] == pytest.approx(ll_each.mean(), rel=1e-5)
+    # Regression facet on the MEAN function exp(margin).
+    err = np.exp(margins) - labels
+    assert m[MEAN_SQUARE_ERROR] == pytest.approx((err ** 2).mean(), rel=1e-5)
+
+
+def test_peak_f1_hand_case():
+    # scores desc: (0.9,1) (0.7,0) (0.5,1) (0.2,0)
+    # F1 at thresholds: 2/3, 1/2, 4/5, 2/3 → peak 0.8
+    scores = np.array([0.9, 0.7, 0.5, 0.2], np.float32)
+    labels = np.array([1, 0, 1, 0], np.float32)
+    assert float(peak_f1(scores, labels)) == pytest.approx(0.8, abs=1e-6)
+
+
+def test_peak_f1_ties_share_threshold():
+    # Tied scores cannot be split: threshold at 0.5 takes BOTH middle
+    # samples. F1 candidates: 2/3 (t=0.9), 4/5 (t=0.5, tp=2 pp=3), 2/3.
+    scores = np.array([0.9, 0.5, 0.5, 0.2], np.float32)
+    labels = np.array([1, 0, 1, 0], np.float32)
+    assert float(peak_f1(scores, labels)) == pytest.approx(0.8, abs=1e-6)
+
+
+def test_perfect_classifier_peak_f1_is_one():
+    scores = np.array([0.9, 0.8, 0.2, 0.1], np.float32)
+    labels = np.array([1, 1, 0, 0], np.float32)
+    assert float(peak_f1(scores, labels)) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_aic_small_sample_degenerate_is_infinite_not_a_crash():
+    """n - k - 1 == 0: Scala doubles give Infinity and the reference logs
+    it harmlessly (Evaluation.scala:117); the port must not raise."""
+    margins = np.array([0.5, -0.5, 0.3, -0.2], np.float32)
+    labels = np.array([1, 0, 1, 0], np.float32)
+    w = np.array([0.5, 1.0, -2.0], np.float32)  # k = 3, n = 4
+    m = metrics_map(TaskType.LOGISTIC_REGRESSION, margins, labels,
+                    coefficients=w)
+    assert math.isinf(m[AKAIKE_INFORMATION_CRITERION])
+
+
+def test_log_likelihood_is_unweighted_per_datum():
+    """averageLogLikelihoodRDD counts 1 per datum — the map must match the
+    reference on any data regardless of sample weights (which the
+    reference's Evaluation.evaluate ignores)."""
+    margins = rng.normal(size=50).astype(np.float32)
+    labels = (rng.random(50) < 0.5).astype(np.float32)
+    m = metrics_map(TaskType.LOGISTIC_REGRESSION, margins, labels)
+    p = 1 / (1 + np.exp(-margins))
+    ll = float(np.mean(labels * np.log(p) + (1 - labels) * np.log1p(-p)))
+    assert m[DATA_LOG_LIKELIHOOD] == pytest.approx(ll, rel=1e-4)
+
+
+def test_selection_metric_directions():
+    assert selection_metric(TaskType.LOGISTIC_REGRESSION) == (
+        AREA_UNDER_ROC, True)
+    assert selection_metric(TaskType.LINEAR_REGRESSION) == (
+        ROOT_MEAN_SQUARE_ERROR, False)
+    assert selection_metric(TaskType.POISSON_REGRESSION) == (
+        DATA_LOG_LIKELIHOOD, True)
+    assert selection_metric(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM) == (
+        AREA_UNDER_ROC, True)

@@ -141,10 +141,23 @@ def test_train_glm_end_to_end_on_heart(tmp_path):
         "--max-iterations", "50",
     ])
     summary = json.loads((out / "training-summary.json").read_text())
-    aucs = [m["validation"]["AUC"] for m in summary["models"]]
+    # Every λ carries the reference's full logistic MetricsMap under the
+    # reference's exact metric names (Evaluation.scala:34-41).
+    expected_keys = {
+        "Area under precision/recall", "Area under ROC", "Peak F1 score",
+        "Per-datum log likelihood", "Akaike information criterion",
+    }
+    for m in summary["models"]:
+        assert set(m["validation"]) == expected_keys, m["validation"]
+        assert 0.0 <= m["validation"]["Peak F1 score"] <= 1.0
+        assert m["validation"]["Per-datum log likelihood"] < 0.0
+    aucs = [m["validation"]["Area under ROC"] for m in summary["models"]]
     # heart_validation.avro holds only 20 samples, so AUC is coarse; clearly
     # above chance is the property (reference DriverTest asserts completion).
     assert max(aucs) > 0.70, summary
+    # Best model selected by AUROC (ModelSelection.selectBestLinearClassifier).
+    best = max(summary["models"], key=lambda m: m["validation"]["Area under ROC"])
+    assert summary["best_lambda"] == best["lambda"]
 
 
 def _index_map_from_model_records(paths):
