@@ -85,9 +85,18 @@ def _local_window(indices, values, shard, factors_loc):
     return local_idx, valid, vals
 
 
+def _acc_dtype(w_dtype):
+    """Accumulation dtype: at LEAST float32 (bf16 values would degrade the
+    margins, the gradient, and through them the curvature pairs — same
+    preferred_element_type discipline as ops/pallas_glm), but float64 is
+    preserved when the coefficients are f64 (the dryrun's tight
+    x64-on-CPU parity certification runs the same program at f64)."""
+    return w_dtype if w_dtype == jnp.float64 else jnp.float32
+
+
 def _l2_masked_local(x_loc, shard, intercept):
     """Local shard of x with the (globally-indexed) intercept zeroed."""
-    xm = x_loc.astype(jnp.float32)
+    xm = x_loc.astype(_acc_dtype(x_loc.dtype))
     if intercept is not None:
         lo = jax.lax.axis_index(FEATURE_AXIS) * shard
         pos = jnp.arange(shard) + lo
@@ -119,23 +128,22 @@ def sparse_value_and_grad_feature_sharded(
         """Runs per device: w_loc (shard,), rows local along data."""
         local_idx, valid, vals = _local_window(indices, values, shard, factors_loc)
 
-        # All accumulation in float32 regardless of the feature-value dtype
-        # (bf16 values would otherwise degrade the margins, the gradient,
-        # and through them the L-BFGS curvature pairs — same
-        # preferred_element_type discipline as ops/pallas_glm).
+        # Accumulation in _acc_dtype (≥ f32; f64 preserved for the x64
+        # parity certification) regardless of the feature-value dtype.
+        acc = _acc_dtype(w_loc.dtype)
         gathered = jnp.where(valid, w_loc[local_idx], 0.0)
         z_partial = jnp.sum(
-            (vals * gathered).astype(jnp.float32), axis=-1
+            (vals * gathered).astype(acc), axis=-1
         )
         z = jax.lax.psum(z_partial, FEATURE_AXIS) + offset
 
         lv = loss.value(z, label)
         dz = weight * loss.dz(z, label)
-        loss_local = jnp.sum(weight * lv).astype(jnp.float32)
+        loss_local = jnp.sum(weight * lv).astype(acc)
 
         # Scatter-add into the local coefficient range only.
-        contrib = jnp.where(valid, vals * dz[:, None], 0.0).astype(jnp.float32)
-        grad_loc = jnp.zeros((shard,), jnp.float32).at[
+        contrib = jnp.where(valid, vals * dz[:, None], 0.0).astype(acc)
+        grad_loc = jnp.zeros((shard,), acc).at[
             local_idx.reshape(-1)
         ].add(contrib.reshape(-1))
         grad_loc = jax.lax.psum(grad_loc, dp)
@@ -146,7 +154,7 @@ def sparse_value_and_grad_feature_sharded(
             grad_loc = grad_loc + l2 * wm
             l2_local = 0.5 * l2 * jnp.sum(wm * wm)
         else:
-            l2_local = jnp.zeros((), jnp.float32)
+            l2_local = jnp.zeros((), acc)
 
         value = jax.lax.pmean(
             jax.lax.psum(loss_local, dp), FEATURE_AXIS
@@ -208,18 +216,21 @@ def sparse_linearized_hvp_feature_sharded(
     def local_d2(w_loc, indices, values, label, offset, weight, factors_loc):
         local_idx, valid, vals = _local_window(indices, values, shard, factors_loc)
         gathered = jnp.where(valid, w_loc[local_idx], 0.0)
-        z_partial = jnp.sum((vals * gathered).astype(jnp.float32), axis=-1)
+        z_partial = jnp.sum(
+            (vals * gathered).astype(_acc_dtype(w_loc.dtype)), axis=-1
+        )
         z = jax.lax.psum(z_partial, FEATURE_AXIS) + offset
         return weight * loss.dzz(z, label)
 
     def local_hv(v_loc, indices, values, d2, factors_loc):
         local_idx, valid, vals = _local_window(indices, values, shard, factors_loc)
+        acc = _acc_dtype(v_loc.dtype)
         v_gather = jnp.where(valid, v_loc[local_idx], 0.0)
-        u_partial = jnp.sum((vals * v_gather).astype(jnp.float32), axis=-1)
+        u_partial = jnp.sum((vals * v_gather).astype(acc), axis=-1)
         u = jax.lax.psum(u_partial, FEATURE_AXIS)  # (A·v) on each data shard
         t = d2 * u
-        contrib = jnp.where(valid, vals * t[:, None], 0.0).astype(jnp.float32)
-        hv_loc = jnp.zeros((shard,), jnp.float32).at[
+        contrib = jnp.where(valid, vals * t[:, None], 0.0).astype(acc)
+        hv_loc = jnp.zeros((shard,), acc).at[
             local_idx.reshape(-1)
         ].add(contrib.reshape(-1))
         hv_loc = jax.lax.psum(hv_loc, dp)
