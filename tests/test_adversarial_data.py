@@ -235,3 +235,163 @@ def test_outlier_fit_close_to_benign_fit_on_signal():
     wb, wo = np.asarray(res_b.w), np.asarray(res_o.w)
     assert np.argmax(np.abs(wb)) == 0
     assert np.argmax(np.abs(wo)) == 0
+
+
+# ---------------------------------------------------------------------------
+# Composable validator chains (VERDICT r4 #8): the reference's ModelValidator
+# family (photon-api integTest supervised/: PredictionFiniteValidator,
+# MaximumDifferenceValidator, NonNegativePredictionValidator,
+# BinaryPredictionValidator, BinaryClassifierAUCValidator,
+# CompositeModelValidator) chained per task over the remaining
+# negative-binomial-sparsity generator variants, at several λ points
+# (BaseGLMIntegTest.scala:86-162; LAMBDAS note :210-212).
+# ---------------------------------------------------------------------------
+
+
+def prediction_finite_validator(means, y):
+    """PredictionFiniteValidator.scala: every prediction finite."""
+    assert np.isfinite(means).all()
+
+
+def maximum_difference_validator(max_diff):
+    """MaximumDifferenceValidator.scala:39-55: no prediction may differ
+    from its response by more than ``max_diff`` (counts violators)."""
+    def check(means, y):
+        too_big = int(np.sum(np.abs(means - y) > max_diff))
+        assert too_big == 0, (
+            f"Found [{too_big}] instances where the prediction error "
+            f"magnitude exceeds [{max_diff}]"
+        )
+    return check
+
+
+def non_negative_prediction_validator(means, y):
+    """NonNegativePredictionValidator.scala: Poisson means >= 0."""
+    assert np.all(means >= 0.0)
+
+
+def binary_prediction_validator(means, y):
+    """BinaryPredictionValidator.scala: thresholded class predictions land
+    exactly in {negativeLabel, positiveLabel}."""
+    cls = np.where(means > 0.5, 1.0, 0.0)
+    assert set(np.unique(cls)) <= {0.0, 1.0}
+
+
+def auc_validator(floor):
+    """BinaryClassifierAUCValidator.scala: AUROC above the floor."""
+    def check(means, y):
+        assert float(auc_roc(jnp.asarray(means), jnp.asarray(y))) > floor
+    return check
+
+
+def composite_validator(*validators):
+    """CompositeModelValidator.scala: run every validator in order."""
+    def check(means, y):
+        for v in validators:
+            v(means, y)
+    return check
+
+
+def benign_linear(seed, n=N, dim=DIM, sparsity=SPARSITY):
+    """numericallyBenignGeneratorFunctionForLinearRegression
+    (SparkTestUtils.scala:585-607): label ~ U[-1, 1], signal feature
+    x0 = label + N(0, INLIER_STD), noise features negative-binomial-skipped
+    uniforms."""
+    rng = np.random.default_rng(seed)
+    rows, y = [], np.empty(n, np.float32)
+    for i in range(n):
+        label = 2.0 * rng.uniform() - 1.0
+        x0 = label + rng.normal() * INLIER_STD
+        ix = _skip_indices(rng, dim, sparsity)
+        vs = [2.0 * (rng.uniform() - 0.5) for _ in ix]
+        rows.append(([0] + ix, [x0] + vs))
+        y[i] = label
+    return _dense_rows(rows, dim), y
+
+
+def benign_poisson(seed, n=N, dim=DIM, sparsity=SPARSITY):
+    """numericallyBenignGeneratorFunctionForPoissonRegression
+    (SparkTestUtils.scala:477-501): label ~ 1 + 10·U, signal feature
+    x0 = (log(label) + N(0, INLIER_STD)) / log(11)."""
+    rng = np.random.default_rng(seed)
+    rows, y = [], np.empty(n, np.float32)
+    for i in range(n):
+        label = 1.0 + rng.uniform() * 10.0
+        x0 = (np.log(label) + rng.normal() * INLIER_STD) / np.log(11.0)
+        ix = _skip_indices(rng, dim, sparsity)
+        vs = [2.0 * (rng.uniform() - 0.5) for _ in ix]
+        rows.append(([0] + ix, [x0] + vs))
+        y[i] = label
+    return _dense_rows(rows, dim), y
+
+
+# BaseGLMIntegTest.scala:220-223 constants.
+MINIMUM_CLASSIFIER_AUCROC = 0.95
+MAXIMUM_ERROR_MAGNITUDE = 10 * INLIER_STD
+
+# Chains per task, mirroring getGeneralizedLinearOptimizationProblems rows.
+# The reference runs LAMBDAS = List(1.0) and notes the strict
+# MaximumDifference bound fails "with all lambdas enabled"
+# (BaseGLMIntegTest.scala:210-212): heavy L2 shrinkage moves predictions
+# more than 10·INLIER_STD by design, so the difference bound applies at
+# λ ≤ 1 and the always-true validators cover the heavier λ points.
+VALIDATOR_PROBLEMS = [
+    ("linear_benign", benign_linear, SquaredLoss, 0.01,
+     composite_validator(
+         prediction_finite_validator,
+         maximum_difference_validator(MAXIMUM_ERROR_MAGNITUDE))),
+    ("linear_benign", benign_linear, SquaredLoss, 1.0,
+     composite_validator(
+         prediction_finite_validator,
+         maximum_difference_validator(MAXIMUM_ERROR_MAGNITUDE))),
+    ("linear_benign_heavy_l2", benign_linear, SquaredLoss, 100.0,
+     prediction_finite_validator),
+    ("poisson_benign", benign_poisson, PoissonLoss, 0.01,
+     composite_validator(
+         prediction_finite_validator, non_negative_prediction_validator)),
+    ("poisson_benign", benign_poisson, PoissonLoss, 1.0,
+     composite_validator(
+         prediction_finite_validator, non_negative_prediction_validator)),
+    ("poisson_benign", benign_poisson, PoissonLoss, 100.0,
+     composite_validator(
+         prediction_finite_validator, non_negative_prediction_validator)),
+    ("logistic_benign", benign_binary, LogisticLoss, 0.01,
+     composite_validator(
+         prediction_finite_validator, binary_prediction_validator,
+         auc_validator(MINIMUM_CLASSIFIER_AUCROC))),
+    ("logistic_benign", benign_binary, LogisticLoss, 1.0,
+     composite_validator(
+         prediction_finite_validator, binary_prediction_validator,
+         auc_validator(MINIMUM_CLASSIFIER_AUCROC))),
+    ("logistic_outlier", outlier_binary, LogisticLoss, 1.0,
+     composite_validator(
+         prediction_finite_validator, binary_prediction_validator,
+         auc_validator(MINIMUM_CLASSIFIER_AUCROC))),
+    ("hinge_benign", benign_binary, SmoothedHingeLoss, 1.0,
+     composite_validator(
+         prediction_finite_validator,
+         auc_validator(MINIMUM_CLASSIFIER_AUCROC))),
+]
+
+
+@pytest.mark.parametrize(
+    "name,gen,loss,lam,validator",
+    VALIDATOR_PROBLEMS,
+    ids=[f"{p[0]}-lam{p[3]:g}" for p in VALIDATOR_PROBLEMS],
+)
+def test_validator_chains(name, gen, loss, lam, validator):
+    """Validator-chain parity with BaseGLMIntegTest: train at λ, run the
+    task's composite validator on the mean-function predictions, and keep
+    FULL Cholesky variances finite throughout (the reference runs variance
+    NONE here; FULL is the stricter photon_tpu addition)."""
+    X, y = gen(seed=31)
+    obj, batch, res = _solve(loss, X, y, l2=lam)
+    w = np.asarray(res.w)
+    assert np.isfinite(w).all()
+    assert res.convergence_reason.name in HONEST_REASONS
+    means = np.asarray(loss.mean(jnp.asarray(X @ w)))
+    validator(means, y)
+    for vtype in (VarianceComputationType.SIMPLE, VarianceComputationType.FULL):
+        v = np.asarray(coefficient_variances(obj, res.w, batch, vtype))
+        assert np.isfinite(v).all(), vtype
+        assert np.all(v > 0.0), vtype
