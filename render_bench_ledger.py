@@ -60,10 +60,59 @@ def main(path: str) -> None:
             if isinstance(v, float):
                 v = round(v, 5)
             print(f"- `{k}`: {v}")
+        _profile_analysis(p)
+
+
+def _profile_analysis(p: dict) -> None:
+    """Derived HBM-utilization answers (VERDICT r4 #2): how much of the
+    pure-streaming ceiling the FE phase achieves, what the Pallas kernel
+    buys over plain XLA, phase overlap headroom, and ingest worker scaling
+    — the arithmetic BENCH_FULL.md's analysis section needs, mechanically."""
+    print("\n### Profile analysis (derived)\n")
+    peak = p.get("hbm_peak_gbps")
+    pure = p.get("pure_x_gbps")
+    fe = p.get("fe_gbps_measured")
+    if isinstance(pure, (int, float)) and isinstance(peak, (int, float)):
+        print(f"- pure X-pass ceiling: {pure:.1f} GB/s = "
+              f"{100 * pure / peak:.1f}% of HBM peak ({peak:.0f} GB/s) — "
+              f"the program-structure bound for dependent thin matmuls")
+    if isinstance(fe, (int, float)):
+        if isinstance(peak, (int, float)):
+            print(f"- FE solve: {fe:.1f} GB/s = {100 * fe / peak:.1f}% of "
+                  f"HBM peak")
+        if isinstance(pure, (int, float)) and pure > 0:
+            print(f"- FE vs ceiling: {100 * fe / pure:.1f}% of the pure-X "
+                  f"ceiling — the gap the solver's non-X work explains")
+    nopal, onpal = p.get("fe_only_nopallas_s"), p.get("fe_only_s")
+    if isinstance(nopal, (int, float)) and isinstance(onpal, (int, float)) \
+            and onpal > 0:
+        print(f"- Pallas fused kernel: {nopal / onpal:.2f}× vs plain XLA "
+              f"on the FE phase ({onpal:.4f}s vs {nopal:.4f}s)")
+    head = p.get("overlap_headroom_s")
+    if isinstance(head, (int, float)):
+        print(f"- phase overlap headroom: {head:+.4f}s "
+              f"(phase_sum_s - full_step_s, from bench.py)")
+    ws = sorted(
+        int(k.split("_w")[-1]) for k in p if k.startswith("ingest_gbps_w")
+    )
+    if len(ws) > 1:
+        base = p[f"ingest_gbps_w{ws[0]}"]
+        scale = ", ".join(
+            f"w{w}: {p[f'ingest_gbps_w{w}']:.3f} GB/s "
+            f"({p[f'ingest_gbps_w{w}'] / base:.1f}×)" for w in ws
+        )
+        print(f"- ingest decode scaling: {scale}")
 
 
 if __name__ == "__main__":
     try:
-        main(sys.argv[1] if len(sys.argv) > 1 else "BENCH_PACK_r04.jsonl")
+        if len(sys.argv) > 1:
+            main(sys.argv[1])
+        else:
+            # Newest round's pack by default.
+            import glob
+
+            packs = sorted(glob.glob("BENCH_PACK_r*.jsonl"))
+            main(packs[-1] if packs else "BENCH_PACK_r04.jsonl")
     except BrokenPipeError:
         pass
