@@ -108,6 +108,18 @@ def build_parser() -> argparse.ArgumentParser:
              "FULL = diagonal of Cholesky-inverted Hessian (reference "
              "DistributedOptimizationProblem.scala:83-103); bare flag = SIMPLE",
     )
+    p.add_argument(
+        "--model-sparsity-threshold", type=float, default=1e-4,
+        help="minimum absolute coefficient value considered nonzero when "
+             "persisting a model (reference modelSparsityThreshold, default "
+             "VectorUtils.DEFAULT_SPARSITY_THRESHOLD = 1e-4)",
+    )
+    p.add_argument(
+        "--ignore-threshold-for-new-models", action="store_true",
+        help="during warm start, entities WITHOUT an existing model bypass "
+             "the random-effect active-data lower bound (reference "
+             "ignoreThresholdForNewModels; requires --model-input-dir)",
+    )
     p.add_argument("--checkpoint-dir", default=None,
                    help="mid-training checkpoint/resume directory (resumes "
                         "automatically when state exists)")
@@ -312,6 +324,8 @@ def run(args) -> Dict:
         num_entities=num_entities,
         locked_coordinates=[s for s in args.locked_coordinates.split(",") if s],
         variance_computation=args.variance_computation,
+        ignore_threshold_for_new_models=args.ignore_threshold_for_new_models,
+        warm_start_model=warm,
     )
     from photon_tpu.utils.events import (
         EventEmitter,
@@ -377,11 +391,13 @@ def run(args) -> Dict:
                     r.model,
                     os.path.join(args.output_dir, "models", f"{key}-{i}"),
                     index_maps, entity_indexes,
+                    sparsity_threshold=args.model_sparsity_threshold,
                 )
     if args.output_mode != "NONE":
         save_game_model(
             best.model, os.path.join(args.output_dir, "best"),
             index_maps, entity_indexes,
+            sparsity_threshold=args.model_sparsity_threshold,
             extra_metadata={"config": best.config.describe()},
         )
         for shard, imap in index_maps.items():

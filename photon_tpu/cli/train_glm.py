@@ -64,13 +64,42 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--task", default="LOGISTIC_REGRESSION", choices=[t.name for t in TaskType])
     p.add_argument("--optimizer", default="LBFGS", choices=[o.name for o in OptimizerType])
     p.add_argument("--regularization-weights", default="0.1,1,10,100")
+    p.add_argument(
+        "--regularization-type", default=None,
+        choices=["NONE", "L1", "L2", "ELASTIC_NET"],
+        help="reference REGULARIZATION_TYPE_OPTION: NONE ignores the "
+             "weights, L1/L2 force the elastic-net alpha to 1/0, "
+             "ELASTIC_NET uses --elastic-net-alpha as given",
+    )
     p.add_argument("--elastic-net-alpha", type=float, default=0.0)
     p.add_argument("--max-iterations", type=int, default=None)
     p.add_argument("--tolerance", type=float, default=None)
+    p.add_argument(
+        "--optimization-state-tracker",
+        action=argparse.BooleanOptionalAction, default=True,
+        help="per-iteration (loss, |grad|) tracker rings "
+             "(OPTIMIZATION_STATE_TRACKER_OPTION)",
+    )
+    p.add_argument(
+        "--validate-per-iteration", action="store_true",
+        help="compute the validation MetricsMap at EVERY optimizer "
+             "iteration count (reference VALIDATE_PER_ITERATION; replays "
+             "the deterministic solve at increasing max-iter — expensive, "
+             "like the reference's warning says)",
+    )
+    p.add_argument(
+        "--feature-dimension", type=int, default=None,
+        help="explicit feature-space dimension for libsvm input "
+             "(FEATURE_DIMENSION option; inferred when omitted)",
+    )
     p.add_argument("--normalization", default="NONE", choices=[t.name for t in NormalizationType])
     p.add_argument("--intercept", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--coefficient-box", default=None,
                    help="lower,upper box constraint applied to all coefficients")
+    p.add_argument("--selected-features-file", default=None,
+                   help="Avro file of FeatureNameTermAvro records; only "
+                        "these features are used for training (reference "
+                        "SELECTED_FEATURES_FILE, avro format only)")
     p.add_argument(
         "--constraint-string",
         default=None,
@@ -99,11 +128,37 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _selected_features_index_map(args) -> Optional[IndexMap]:
+    """SELECTED_FEATURES_FILE role (PhotonMLCmdLineParser.scala:203-205,
+    GLMSuite.getSelectedFeatureSetFromFile): an Avro file of
+    FeatureNameTermAvro records restricting the training feature space.
+    Features outside the set are dropped at ingest (the reader masks
+    features absent from a provided index map)."""
+    if not args.selected_features_file:
+        return None
+    if args.format == "libsvm":
+        raise ValueError(
+            "--selected-features-file applies to the avro format "
+            "(features are name/term keyed)"
+        )
+    from photon_tpu.io.avro import AvroReader
+
+    keys = set()
+    with AvroReader(args.selected_features_file) as r:
+        for rec in r:
+            keys.add(IndexMap.key(rec["name"], rec.get("term") or ""))
+    if not keys:
+        raise ValueError(
+            f"no features in {args.selected_features_file}"
+        )
+    return IndexMap.build(sorted(keys), add_intercept=args.intercept)
+
+
 def _load(args, path: Optional[str], index_map=None):
     if path is None:
         return None, index_map
     if args.format == "libsvm":
-        X, y = read_libsvm(path)
+        X, y = read_libsvm(path, dim=args.feature_dimension)
         if args.intercept:
             X = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], axis=1)
         imap = index_map or IndexMap.build(
@@ -126,7 +181,12 @@ def run(args) -> Dict:
     for name in args.event_listeners:
         emitter.register_by_name(name)
 
-    train, imap = _load(args, args.training_data)
+    if args.validate_per_iteration and args.validation_data is None:
+        raise ValueError(
+            "--validate-per-iteration requires --validation-data"
+        )
+    train, imap = _load(args, args.training_data,
+                        _selected_features_index_map(args))
     valid, _ = _load(args, args.validation_data, imap)
     from photon_tpu.data.validators import DataValidationType, validate_labeled_batch
 
@@ -173,6 +233,16 @@ def run(args) -> Dict:
         if bounds is not None:
             box = (jnp.asarray(bounds[0]), jnp.asarray(bounds[1]))
 
+    # REGULARIZATION_TYPE_OPTION semantics (PhotonMLCmdLineParser.scala:
+    # 100-116): NONE ignores the weights entirely; L1/L2 pin the
+    # elastic-net mix; ELASTIC_NET takes the alpha as given.
+    if args.regularization_type == "NONE":
+        args.regularization_weights = "0"
+    elif args.regularization_type == "L1":
+        args.elastic_net_alpha = 1.0
+    elif args.regularization_type == "L2":
+        args.elastic_net_alpha = 0.0
+
     weights = sorted(float(x) for x in args.regularization_weights.split(","))
     weights.reverse()  # strongest first: warm start toward weaker reg
     loss = loss_for_task(task)
@@ -189,9 +259,11 @@ def run(args) -> Dict:
             normalization=norm,
         )
         spec = OptimizerSpec(
-            OptimizerType[args.optimizer], args.max_iterations, args.tolerance, box=box
+            OptimizerType[args.optimizer], args.max_iterations, args.tolerance,
+            box=box, track_history=args.optimization_state_tracker,
         )
         solve = make_optimizer(objective, spec)
+        w0_lam = w
         result = solve(w, train)
         w = result.w  # warm start (ModelTraining.scala:162-200)
         w_model = norm.transformed_to_model_space(w) if norm is not None else w
@@ -211,6 +283,11 @@ def run(args) -> Dict:
                 "loss": float(result.value),
                 "iterations": int(result.iterations),
                 "reason": result.convergence_reason.value,
+                # Replay handles for --validate-per-iteration (stripped
+                # from the serialized summary).
+                "_objective": objective,
+                "_spec": spec,
+                "_w0": w0_lam,
             }
         )
         emitter.emit(
@@ -240,6 +317,28 @@ def run(args) -> Dict:
             )
             m["validation"] = mmap
             log.info("Model with lambda = %g:", m["lambda"])
+            if args.validate_per_iteration:
+                # VALIDATE_PER_ITERATION (Driver.scala:354-376): metrics at
+                # every iteration count. The deterministic solver replayed
+                # from the same warm start with max_iter=j reproduces the
+                # tracker's state-j coefficients exactly; one compile per j.
+                import dataclasses as _dc
+
+                per_iter = []
+                for j in range(1, int(m["iterations"]) + 1):
+                    spec_j = _dc.replace(m["_spec"], max_iter=j)
+                    res_j = make_optimizer(m["_objective"], spec_j)(
+                        m["_w0"], train
+                    )
+                    w_j = (norm.transformed_to_model_space(res_j.w)
+                           if norm is not None else res_j.w)
+                    mm_j = metrics_map(task, valid.margins(w_j), valid.label,
+                                       coefficients=w_j)
+                    per_iter.append(mm_j)
+                    for name in sorted(mm_j):  # Driver.scala:368-373 shape
+                        log.info("Iteration: [%6d] Metric: [%s] value: %s",
+                                 j, name, mm_j[name])
+                m["per_iteration_validation"] = per_iter
             for name in sorted(mmap):  # Driver.scala:400-405 log shape
                 log.info("Metric: [%s] value: %s", name, mmap[name])
             v = mmap[sel_name]
@@ -279,7 +378,9 @@ def run(args) -> Dict:
     summary = {
         "best_lambda": best["lambda"],
         "models": [
-            {k: v for k, v in m.items() if k not in ("w", "variances")} for m in models
+            {k: v for k, v in m.items()
+             if k not in ("w", "variances") and not k.startswith("_")}
+            for m in models
         ],
         "stage": stage.name,
     }

@@ -186,6 +186,7 @@ def build_random_effect_dataset(
     num_entities: int,
     config: RandomEffectDataConfig,
     uid: Optional[np.ndarray] = None,
+    existing_model_mask: Optional[np.ndarray] = None,
 ) -> RandomEffectDataset:
     """Host-side grouping: the TPU analogue of RandomEffectDataset.apply
     (reference :260-349 build pipeline).
@@ -193,6 +194,12 @@ def build_random_effect_dataset(
     Samples per entity beyond ``active_upper_bound`` are dropped from active
     training data via deterministic reservoir sampling (they remain passive:
     still scored through the flat batch).
+
+    ``existing_model_mask`` ((num_entities,) bool, warm-start only):
+    entities WITHOUT an existing model are exempt from
+    ``active_lower_bound`` — the reference's ignoreThresholdForNewModels
+    flag (GameTrainingDriver.scala:169-172, RandomEffectDataset.scala:
+    550-570: keep entity if count >= bound OR id not in existing keys).
 
     ``features`` is either a dense (n, d) array or a host-side padded-sparse
     triple ``(indices (n,k) int, values (n,k) float, dim)`` — the wide-shard
@@ -304,7 +311,10 @@ def build_random_effect_dataset(
             wt[j, :m] = weight[rows]
             sidx[j, :m] = rows
             eidx[j] = eid
-            tmask[j] = m >= lb
+            tmask[j] = m >= lb or (
+                existing_model_mask is not None
+                and not bool(existing_model_mask[eid])
+            )
         blocks.append(
             EntityBlock(
                 entity_idx=jnp.asarray(eidx),

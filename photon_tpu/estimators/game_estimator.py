@@ -85,6 +85,8 @@ class GameEstimator:
         num_entities: Optional[Dict[str, int]] = None,
         locked_coordinates: Sequence[str] = (),
         variance_computation: object = None,  # VarianceComputationType/bool/str
+        ignore_threshold_for_new_models: bool = False,
+        warm_start_model=None,  # GameModel the flag reads existing ids from
     ):
         self.task = task
         self.coordinate_configs = list(coordinate_configs)
@@ -94,6 +96,18 @@ class GameEstimator:
         self.num_entities = num_entities or {}
         self.locked_coordinates = list(locked_coordinates)
         self.variance_computation = normalize_variance_type(variance_computation)
+        # ignoreThresholdForNewModels (GameTrainingDriver.scala:169-172):
+        # during warm start, entities WITHOUT an existing model bypass the
+        # RE active-data lower bound. The reference validates this pairing
+        # at driver start (validateParams, :250-252) — mirrored here at
+        # construction so a mid-sweep tuning fit can never trip it.
+        self.ignore_threshold_for_new_models = bool(ignore_threshold_for_new_models)
+        self.warm_start_model = warm_start_model
+        if self.ignore_threshold_for_new_models and warm_start_model is None:
+            raise ValueError(
+                "'Ignore threshold for new models' flag set but no initial "
+                "model provided for warm-start"
+            )
         self.update_sequence = [c.coordinate_id for c in self.coordinate_configs]
 
     def _variance_type(self, cfg):
@@ -185,6 +199,24 @@ class GameEstimator:
             if isinstance(cfg, RandomEffectCoordinateConfig):
                 eids = np.asarray(batch.entity_ids[cfg.re_type])
                 E = self.num_entities.get(cfg.re_type, int(eids.max()) + 1 if eids.size else 0)
+                existing = None
+                if self.ignore_threshold_for_new_models:
+                    # Entities with an existing model in the warm-start
+                    # GameModel; ids outside it bypass the bound. Presence
+                    # comes from the loader's record-membership mask when
+                    # available (L1-zeroed models still count as existing,
+                    # matching the reference's key-presence semantics);
+                    # nonzero rows are the fallback for in-memory models.
+                    existing = np.zeros((E,), bool)
+                    prev_model = self.warm_start_model.get(cfg.coordinate_id)
+                    if prev_model is not None:
+                        pm = getattr(prev_model, "present_entities", None)
+                        src = (np.asarray(pm) if pm is not None
+                               else np.any(
+                                   np.asarray(prev_model.coefficients) != 0.0,
+                                   axis=1))
+                        k = min(E, src.shape[0])
+                        existing[:k] = src[:k]
                 self._re_datasets[cfg.coordinate_id] = build_random_effect_dataset(
                     eids,
                     feats_np[cfg.feature_shard],
@@ -199,6 +231,7 @@ class GameEstimator:
                         features_to_samples_ratio=cfg.features_to_samples_ratio,
                     ),
                     uid=None if batch.uid is None else np.asarray(batch.uid),
+                    existing_model_mask=existing,
                 )
         self._prepared_for = batch
 

@@ -390,6 +390,7 @@ def load_game_model(
                 eidx.intern(rec["modelId"])
             E = len(eidx)
             coefs = np.zeros((E, dim), np.float32)
+            present = np.zeros((E,), bool)
             variances_arr = None
             for rec in recs:
                 e = eidx.lookup(rec["modelId"])
@@ -397,6 +398,7 @@ def load_game_model(
                 if info.get("task_inferred") and rec_task is not None:
                     task = rec_task  # modelClass beats the modelType guess
                 coefs[e] = means
+                present[e] = True
                 if variances is not None:
                     if variances_arr is None:
                         variances_arr = np.zeros((E, dim), np.float32)
@@ -407,6 +409,7 @@ def load_game_model(
                 shard,
                 task,
                 None if variances_arr is None else jnp.asarray(variances_arr),
+                present_entities=jnp.asarray(present),
             )
     return GameModel(models)
 

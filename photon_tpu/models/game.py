@@ -57,6 +57,12 @@ class RandomEffectModel:
     feature_shard: str = dataclasses.field(metadata=dict(static=True))
     task: TaskType = dataclasses.field(metadata=dict(static=True))
     variances: Optional[Array] = None
+    # (E,) bool — which entities had a persisted per-entity model record
+    # (set by load_game_model). Distinguishes a legitimately all-zero
+    # L1-sparsified model from an entity that was never trained — the
+    # reference keys existing-model checks on record membership
+    # (RandomEffectDataset.scala:550-570), not coefficient values.
+    present_entities: Optional[Array] = None
 
     @property
     def num_entities(self) -> int:

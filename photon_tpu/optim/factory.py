@@ -36,6 +36,8 @@ class OptimizerSpec:
     memory: int = 10
     max_cg_iter: int = 20
     box: Optional[Tuple[Array, Array]] = None
+    # OPTIMIZATION_STATE_TRACKER_OPTION (PhotonMLCmdLineParser.scala:136-139)
+    track_history: bool = True
 
     def config(self) -> OptimizerConfig:
         base = TRON_DEFAULT_CONFIG if self.optimizer == OptimizerType.TRON else OptimizerConfig()
@@ -43,6 +45,7 @@ class OptimizerSpec:
             max_iter=self.max_iter if self.max_iter is not None else base.max_iter,
             tol=self.tol if self.tol is not None else base.tol,
             memory=self.memory,
+            track_history=self.track_history,
         )
 
 

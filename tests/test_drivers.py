@@ -418,3 +418,53 @@ def test_game_scoring_streaming_matches_slurp(fixture_dir, tmp_path):
     assert r_stream["metrics"] == pytest.approx(r_slurp["metrics"], abs=1e-6)
     assert uid_stream == uid_slurp  # order preserved
     np.testing.assert_allclose(sc_stream, sc_slurp, rtol=0, atol=0)
+
+
+def test_legacy_driver_per_iteration_validation_and_reg_type(tmp_path):
+    """VALIDATE_PER_ITERATION + REGULARIZATION_TYPE parity: per-iteration
+    MetricsMaps land in the summary (one per iteration, final map equal to
+    the standard validation map), and --regularization-type NONE ignores
+    the weights (PhotonMLCmdLineParser.scala:100-116, Driver.scala:354-376)."""
+    libsvm = tmp_path / "t.txt"
+    lines = []
+    w = np.array([1.0, -1.5, 0.5])
+    for i in range(200):
+        x = rng.normal(size=3)
+        y = 1 if rng.uniform() < 1 / (1 + np.exp(-x @ w)) else -1
+        lines.append(f"{y:+d} " + " ".join(f"{j+1}:{x[j]:.4f}" for j in range(3)))
+    libsvm.write_text("\n".join(lines))
+    out = tmp_path / "o"
+    args = train_glm.build_parser().parse_args(
+        [
+            "--training-data", str(libsvm),
+            "--validation-data", str(libsvm),
+            "--format", "libsvm",
+            "--output-dir", str(out),
+            "--regularization-weights", "1",
+            "--max-iterations", "8",
+            "--validate-per-iteration",
+        ]
+    )
+    summary = train_glm.run(args)
+    (m,) = summary["models"]
+    per_iter = m["per_iteration_validation"]
+    assert len(per_iter) == m["iterations"]
+    assert per_iter[-1]["Area under ROC"] == pytest.approx(
+        m["validation"]["Area under ROC"], abs=1e-6
+    )
+    # AUROC at the last iteration should not be worse than at the first.
+    assert per_iter[-1]["Area under ROC"] >= per_iter[0]["Area under ROC"] - 1e-3
+
+    # NONE regularization type ignores the weight list.
+    out2 = tmp_path / "o2"
+    args2 = train_glm.build_parser().parse_args(
+        [
+            "--training-data", str(libsvm), "--format", "libsvm",
+            "--output-dir", str(out2),
+            "--regularization-weights", "0.1,1,10",
+            "--regularization-type", "NONE",
+        ]
+    )
+    summary2 = train_glm.run(args2)
+    assert len(summary2["models"]) == 1
+    assert summary2["models"][0]["lambda"] == 0.0

@@ -313,3 +313,63 @@ def test_normalization_folded_matches_explicit_pretransform():
                                 Xr_explicit.astype(np.float32)))
     )
     np.testing.assert_allclose(s_folded, s_explicit, rtol=2e-3, atol=2e-3)
+
+
+def test_active_lower_bound_and_ignore_threshold_for_new_models():
+    """Reference ignoreThresholdForNewModels (GameTrainingDriver.scala:
+    169-172 + RandomEffectDataset.filterActiveData:550-570): with a
+    warm-start model, entities WITHOUT an existing model bypass the
+    active-data lower bound; entities WITH one must still meet it."""
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfig, build_random_effect_dataset,
+    )
+
+    n_e, d = 4, 3
+    # entity 0: 5 samples, 1: 2 samples, 2: 2 samples, 3: 5 samples
+    counts = [5, 2, 2, 5]
+    eids = np.concatenate([np.full(c, e, np.int32) for e, c in enumerate(counts)])
+    n = eids.size
+    feats = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+    cfg = RandomEffectDataConfig(
+        re_type="userId", feature_shard="re", active_lower_bound=3, n_buckets=1
+    )
+
+    def trainable(ds):
+        out = {}
+        for b in ds.blocks:
+            for eid, m in zip(np.asarray(b.entity_idx), np.asarray(b.train_mask)):
+                out[int(eid)] = bool(m)
+        return out
+
+    # No warm start: the bound applies to everyone.
+    t = trainable(build_random_effect_dataset(eids, feats, y, w, n_e, cfg))
+    assert t == {0: True, 1: False, 2: False, 3: True}
+
+    # Warm start where entity 1 HAS a model and entity 2 does NOT:
+    # 1 must still meet the bound (fails), 2 is exempt (trains).
+    existing = np.array([True, True, False, True])
+    t = trainable(build_random_effect_dataset(
+        eids, feats, y, w, n_e, cfg, existing_model_mask=existing
+    ))
+    assert t == {0: True, 1: False, 2: True, 3: True}
+
+
+def test_ignore_threshold_requires_warm_start_model():
+    """GameTrainingDriver.scala:250-252 require parity."""
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+    )
+
+    with pytest.raises(ValueError, match="warm-start"):
+        GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs=[
+                FixedEffectCoordinateConfig(
+                    coordinate_id="global", feature_shard="global"
+                )
+            ],
+            ignore_threshold_for_new_models=True,
+        )

@@ -457,3 +457,41 @@ def test_partial_retrain_from_reference_model():
     )
     assert np.isfinite(coefs).all()
     assert float(np.abs(coefs).sum()) > 0.0, "locked retrain trained nothing"
+
+
+def test_selected_features_file_restricts_training(tmp_path):
+    """SELECTED_FEATURES_FILE parity (PhotonMLCmdLineParser.scala:203-205 /
+    GLMSuite.scala:109-111): only listed features train; everything else is
+    dropped at ingest."""
+    from photon_tpu.cli.train_glm import main
+    from photon_tpu.io.avro import write_avro_records
+
+    name_term_schema = {
+        "type": "record", "name": "FeatureNameTermAvro",
+        "fields": [
+            {"name": "name", "type": "string"},
+            {"name": "term", "type": ["null", "string"], "default": None},
+        ],
+    }
+    sel_path = tmp_path / "selected.avro"
+    keep = ["1", "3", "7"]
+    write_avro_records(
+        str(sel_path), name_term_schema,
+        [{"name": n, "term": ""} for n in keep],
+    )
+    out = tmp_path / "out"
+    main([
+        "--training-data", os.path.join(DRIVER_INPUT, "heart.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--regularization-weights", "1",
+        "--max-iterations", "30",
+        "--selected-features-file", str(sel_path),
+    ])
+    (model_file,) = [f for f in os.listdir(out)
+                     if f.startswith("model-lambda-")]
+    names = [line.split("\t")[0] for line in open(out / model_file)
+             if not line.startswith("#")]
+    allowed = set(keep) | {"(INTERCEPT)"}
+    assert names, "model must have nonzero coefficients"
+    assert set(names) <= allowed, names
