@@ -531,6 +531,126 @@ def _profile_ingest(n_rows: int = 1 << 17, d: int = 48, nnz: int = 12) -> dict:
     return out
 
 
+def run_solve_cache_ab():
+    """Bucketed-vs-exact A/B for the compiled-solver cache
+    (algorithm/solve_cache.py): retrace/cache-hit accounting over 3 CD-style
+    passes of the random-effect coordinate, plus coefficient parity between
+    shape-bucketed and exact-shape datasets. CPU-measurable — retrace count
+    and host-sync count do not need the hardware tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.algorithm.random_effect import RandomEffectCoordinate
+    from photon_tpu.algorithm.solve_cache import SolveCache
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.factory import OptimizerSpec
+    from photon_tpu.types import OptimizerType, TaskType
+
+    rng = np.random.default_rng(7)
+    E_ab, d_ab, passes = 240, 8, 3
+    # Two size clusters with jittered counts — the case bucketing exists
+    # for: the quantile grouping yields blocks whose exact (E, n_max) all
+    # differ slightly (one executable each), but which round to the SAME
+    # bucket shape, collapsing onto a couple of cached executables.
+    counts = np.where(
+        rng.uniform(size=E_ab) < 0.5,
+        rng.integers(5, 7, size=E_ab),
+        rng.integers(37, 48, size=E_ab),
+    ).astype(int)
+    users_ab = np.repeat(np.arange(E_ab, dtype=np.int32), counts)
+    n_ab = users_ab.size
+    Xr_ab = rng.normal(size=(n_ab, d_ab)).astype(np.float32)
+    Xr_ab[:, 0] = 1.0
+    y_ab = (rng.uniform(size=n_ab) < 0.5).astype(np.float32)
+    w_ab = np.ones(n_ab, np.float32)
+    batch = GameBatch(
+        label=jnp.asarray(y_ab),
+        offset=jnp.zeros(n_ab, jnp.float32),
+        weight=jnp.asarray(w_ab),
+        features={"re": jnp.asarray(Xr_ab)},
+        entity_ids={"userId": jnp.asarray(users_ab)},
+    )
+
+    def run_variant(bucketed: bool):
+        ds = build_random_effect_dataset(
+            users_ab, Xr_ab, y_ab, w_ab, E_ab,
+            RandomEffectDataConfig(
+                re_type="userId", feature_shard="re", n_buckets=6,
+                shape_bucketing=bucketed, subspace_projection=False,
+            ),
+        )
+        cache = SolveCache(donate=True)
+        coord = RandomEffectCoordinate(
+            coordinate_id="per_user",
+            dataset=ds,
+            task=TaskType.LOGISTIC_REGRESSION,
+            objective=GLMObjective(
+                loss=LogisticLoss, l2_weight=0.5, intercept_index=0
+            ),
+            # Newton (the RE hot-path solver): quadratic convergence pulls
+            # both variants to the same optimum, so parity reflects the
+            # objective, not trajectory noise.
+            optimizer_spec=OptimizerSpec(
+                optimizer=OptimizerType.NEWTON, max_iter=25, tol=1e-8
+            ),
+            solve_cache=cache,
+        )
+        model, wall = None, []
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            model, _stats = coord.train(batch, None, model)
+            jax.block_until_ready(model.coefficients)
+            wall.append(time.perf_counter() - t0)
+        return model, cache.stats, len(ds.blocks), wall
+
+    _progress("solve-cache A/B: bucketed variant")
+    m_b, st_b, blocks_b, wall_b = run_variant(True)
+    _progress("solve-cache A/B: exact variant")
+    m_e, st_e, blocks_e, wall_e = run_variant(False)
+
+    cb = np.asarray(m_b.coefficients)[:, :d_ab]
+    ce = np.asarray(m_e.coefficients)[:, :d_ab]
+    max_abs = float(np.max(np.abs(cb - ce)))
+    denom = np.maximum(np.abs(ce), 1e-30)
+    max_rel = float(np.max(np.abs(cb - ce) / denom))
+    # f32 cross-shape bar: padding changes XLA reduction trees, so Newton
+    # trajectories drift at f32 rounding scale (same 2e-3 bar as the
+    # cross-solver comparisons in tests/test_newton.py). The strict
+    # rtol-1e-6 parity claim is asserted in f64 by
+    # tests/test_solve_cache.py::test_bucketed_vs_exact_parity.
+    parity_f32 = bool(np.allclose(cb, ce, rtol=2e-3, atol=1e-5))
+
+    hit_rate = st_b.hits / max(st_b.calls, 1)
+    return dict(
+        metric="solve_cache_bucketed_hit_rate",
+        value=round(hit_rate, 4),
+        unit="cache_hits/dispatch",
+        cd_passes=passes,
+        blocks_bucketed=blocks_b,
+        blocks_exact=blocks_e,
+        traces_bucketed=st_b.traces,
+        traces_exact=st_e.traces,
+        calls_bucketed=st_b.calls,
+        hits_bucketed=st_b.hits,
+        hits_exact=st_e.hits,
+        distinct_trace_shapes_bucketed=len(set(st_b.trace_keys)),
+        distinct_trace_shapes_exact=len(set(st_e.trace_keys)),
+        bucketed_vs_exact_max_abs_diff=max_abs,
+        bucketed_vs_exact_max_rel_diff=max_rel,
+        parity_f32_rtol_2e3=parity_f32,
+        first_pass_s_bucketed=round(wall_b[0], 4),
+        steady_pass_s_bucketed=round(min(wall_b[1:]), 4),
+        first_pass_s_exact=round(wall_e[0], 4),
+        steady_pass_s_exact=round(min(wall_e[1:]), 4),
+    )
+
+
 def measure_cpu_baseline():
     """Same workload on CPU: scipy L-BFGS-B fixed effect + per-entity scipy
     solves, with identical data-pass accounting."""
@@ -712,6 +832,7 @@ def run_pack(out_path: str) -> None:
     # configs. Resume skips whatever already captured cleanly.
     sections = [
         ("glmix_logistic_samples_per_sec_per_chip", run_glmix_bench),
+        ("solve_cache_bucketed_hit_rate", run_solve_cache_ab),
         ("libsvm_logistic_sweep_samples_per_sec_per_chip", bc.run_libsvm_sweep),
         ("glmix_profile_phase_split", run_profile),
         ("sparse_wide_logistic_samples_per_sec_per_chip", bc.run_sparse_wide),
@@ -757,14 +878,18 @@ def run_pack(out_path: str) -> None:
         timer.daemon = True
         timer.start()
         try:
-            r = fn()
-        except Exception as exc:  # noqa: BLE001 — keep capturing evidence
-            r = _error_line(metric, exc, pack_path=out_path)
-        with io_lock:
-            with open(out_path, "a") as f:
-                f.write(json.dumps(r) + "\n")
-            section_done.set()
-        timer.cancel()
+            try:
+                r = fn()
+            except Exception as exc:  # noqa: BLE001 — keep capturing evidence
+                r = _error_line(metric, exc, pack_path=out_path)
+            with io_lock:
+                with open(out_path, "a") as f:
+                    f.write(json.dumps(r) + "\n")
+                section_done.set()
+        finally:
+            # Must disarm even on KeyboardInterrupt/SystemExit — a still-armed
+            # watchdog os._exit(4)s later and masks the interrupt.
+            timer.cancel()
         if r.get("metric") != "glmix_profile_phase_split" or "error" in r:
             print(json.dumps(r), flush=True)
 
@@ -825,6 +950,11 @@ def main():
             sys.exit(2)
         _backend_watchdog()
         run_pack(out_path)
+        return
+    if "--solve-cache-ab" in sys.argv:
+        # Retrace/hit accounting + bucketed-vs-exact parity; CPU-measurable,
+        # no backend watchdog needed (no tunnel involvement).
+        print(json.dumps(run_solve_cache_ab()))
         return
     _backend_watchdog()
     try:

@@ -106,12 +106,19 @@ class CoordinateDescent:
         checkpoint_every: int = 1,
         checkpoint_tag: Optional[str] = None,
         emitter=None,  # utils.events.EventEmitter; optimization-log events
+        profile: bool = True,
     ) -> CoordinateDescentResult:
         """Descend; with validation data, tracks the best model seen across
         iterations by the primary metric (descendWithValidation role).
 
         ``better(new, old)`` encodes metric direction (reference
         EvaluatorType.op); default assumes lower-is-better.
+
+        ``profile=True`` (default) blocks on each coordinate's scores so
+        ``wall_times`` covers device execution. ``profile=False`` removes
+        every ``block_until_ready`` between coordinate updates — back-to-back
+        coordinates stay enqueued on device with no host sync, and the
+        recorded wall times measure dispatch only.
 
         With ``checkpoint_dir``, full descent state (models, score arrays,
         iteration counter, metric history) is persisted every
@@ -214,8 +221,9 @@ class CoordinateDescent:
                 residual = None if single else total_scores - scores[cid]
                 model, diag = coord.train(batch, residual, models[cid])
                 new_scores = coord.score(model, batch)
-                # The clock must cover device execution, not just dispatch.
-                jax.block_until_ready(new_scores)
+                if profile:
+                    # The clock must cover device execution, not dispatch.
+                    jax.block_until_ready(new_scores)
                 wall = time.monotonic() - t0
                 total_scores = total_scores - scores[cid] + new_scores
                 scores[cid] = new_scores

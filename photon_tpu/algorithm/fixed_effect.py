@@ -28,7 +28,8 @@ from photon_tpu.models.glm import GeneralizedLinearModel
 from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.ops.variance import coefficient_variances, normalize_variance_type
 from photon_tpu.optim.common import OptimizeResult
-from photon_tpu.optim.factory import OptimizerSpec, make_optimizer
+from photon_tpu.optim.factory import OptimizerSpec
+from photon_tpu.algorithm.solve_cache import SolveCache, default_cache
 from photon_tpu.sampling.down_sampler import DownSampler
 from photon_tpu.types import TaskType, VarianceComputationType
 
@@ -47,9 +48,15 @@ class FixedEffectCoordinate(Coordinate):
     # for compatibility (True → SIMPLE).
     compute_variance: object = VarianceComputationType.NONE
     dim: Optional[int] = None  # inferred from the batch if None
+    # Shared compiled-executable cache (algorithm/solve_cache.py): the full
+    # optimizer run is one jitted program per (objective, spec), reused
+    # across CD passes and across coordinates with identical configs.
+    solve_cache: Optional[SolveCache] = None
 
     def __post_init__(self):
         self.compute_variance = normalize_variance_type(self.compute_variance)
+        if self.solve_cache is None:
+            self.solve_cache = default_cache()
 
     def train(
         self,
@@ -76,7 +83,7 @@ class FixedEffectCoordinate(Coordinate):
         folded = norm is not None and not norm.is_identity
         if folded:
             w0 = norm.model_to_transformed_space(w0)
-        solve = make_optimizer(self.objective, self.optimizer_spec)
+        solve = self.solve_cache.fe_solver(self.objective, self.optimizer_spec)
         result = solve(w0, lb)
         # SIMPLE/FULL variance computation
         # (DistributedOptimizationProblem.scala:83-103 role). Evaluated at

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_tpu.algorithm.coordinate import Coordinate
+from photon_tpu.algorithm.solve_cache import SolveCache, default_cache
 from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.game_data import GameBatch
 from photon_tpu.data.random_effect import EntityBlock, RandomEffectDataset, pearson_feature_mask
@@ -63,13 +64,53 @@ NEWTON_AUTO_MAX_DIM = 128
 class RandomEffectTrackerStats:
     """Aggregate convergence stats across entity solves
     (RandomEffectOptimizationTracker.scala role). A pytree so trackers ride
-    along in coordinate-descent checkpoints."""
+    along in coordinate-descent checkpoints.
 
-    num_entities: int
-    num_converged: int
-    num_max_iter: int
-    mean_iterations: float
-    max_iterations: int
+    The per-row iteration/reason arrays stay ON DEVICE: building the tracker
+    after a coordinate pass costs no host sync, so the coordinate-descent
+    sequence never blocks mid-pass on diagnostics. Python scalars
+    materialize lazily — through the aggregate properties or ``summary()``,
+    which is where the device→host transfer happens. ``valid`` masks
+    shape-bucket padding rows out of every aggregate.
+    """
+
+    iterations: Array  # (T,) per-row iteration counts, blocks concatenated
+    reasons: Array  # (T,) per-row termination reason codes
+    valid: Array  # (T,) bool — False for shape-bucket padding rows
+
+    @staticmethod
+    def empty() -> "RandomEffectTrackerStats":
+        z = jnp.zeros((0,), jnp.int32)
+        return RandomEffectTrackerStats(z, z, jnp.zeros((0,), bool))
+
+    @property
+    def num_entities(self) -> int:
+        return int(jnp.sum(self.valid))
+
+    @property
+    def num_converged(self) -> int:
+        conv = (self.reasons == REASON_FUNCTION_VALUES_CONVERGED) | (
+            self.reasons == REASON_GRADIENT_CONVERGED
+        )
+        return int(jnp.sum(conv & self.valid))
+
+    @property
+    def num_max_iter(self) -> int:
+        return int(jnp.sum((self.reasons == REASON_MAX_ITERATIONS) & self.valid))
+
+    @property
+    def mean_iterations(self) -> float:
+        n = jnp.maximum(jnp.sum(self.valid), 1)
+        return float(
+            jnp.sum(jnp.where(self.valid, self.iterations, 0).astype(jnp.float32))
+            / n
+        )
+
+    @property
+    def max_iterations(self) -> int:
+        if self.iterations.shape[0] == 0:
+            return 0
+        return int(jnp.max(jnp.where(self.valid, self.iterations, 0)))
 
     def summary(self) -> str:
         return (
@@ -215,9 +256,16 @@ class RandomEffectCoordinate(Coordinate):
     # SIMPLE (diag-inverse) or FULL (Cholesky inverse diagonal, vmapped over
     # entities); bool accepted for compatibility (True → SIMPLE).
     compute_variance: object = VarianceComputationType.NONE
+    # Compiled-solver cache; None → the process-wide shared default
+    # (algorithm/solve_cache.default_cache), so every coordinate / λ-sweep
+    # config with the same static setup reuses one executable per shape
+    # bucket instead of retracing each CD pass.
+    solve_cache: Optional[SolveCache] = None
 
     def __post_init__(self):
         self.compute_variance = normalize_variance_type(self.compute_variance)
+        if self.solve_cache is None:
+            self.solve_cache = default_cache()
         # Per-entity solves keep only aggregate tracker stats (HBM budget).
         self._config = dataclasses.replace(
             self.optimizer_spec.config(), track_history=False
@@ -238,6 +286,13 @@ class RandomEffectCoordinate(Coordinate):
                 self._feature_masks[i] = pearson_feature_mask(
                     block, k_e, always_keep=self._block_intercept(block)
                 )
+        # Memoized per-block objectives: the solver-cache key pins the
+        # normalization arrays by identity, so they must be built ONCE and
+        # reused across CD passes (rebuilding each pass would defeat the
+        # compile cache).
+        self._block_objectives = [
+            self._block_objective(b) for b in self.dataset.blocks
+        ]
 
     def _block_intercept(self, block: EntityBlock) -> Optional[int]:
         """Intercept column in BLOCK-local space (global index mapped through
@@ -262,6 +317,33 @@ class RandomEffectCoordinate(Coordinate):
                 factors=None if norm.factors is None else norm.factors[block.col_map],
                 shifts=None if norm.shifts is None else norm.shifts[block.col_map],
                 intercept_index=local,
+            )
+            return dataclasses.replace(
+                self.objective, intercept_index=local, normalization=norm
+            )
+        if (
+            block.col_map is None
+            and block.dim > self.dataset.dim
+            and norm is not None
+            and not norm.is_identity
+        ):
+            # Dense block padded to a d bucket: extend the normalization
+            # vectors with identity entries (factor 1, shift 0) so the folded
+            # algebra matches the padded width. Padded columns are all-zero
+            # features, so their coefficients stay at the warm start.
+            pad = block.dim - self.dataset.dim
+            norm = dataclasses.replace(
+                norm,
+                factors=None
+                if norm.factors is None
+                else jnp.concatenate(
+                    [norm.factors, jnp.ones((pad,), norm.factors.dtype)]
+                ),
+                shifts=None
+                if norm.shifts is None
+                else jnp.concatenate(
+                    [norm.shifts, jnp.zeros((pad,), norm.shifts.dtype)]
+                ),
             )
             return dataclasses.replace(
                 self.objective, intercept_index=local, normalization=norm
@@ -297,17 +379,30 @@ class RandomEffectCoordinate(Coordinate):
             if initial_model is not None
             else jnp.zeros((E, d), dtype)
         )
-        iter_list, reason_list = [], []
+        # Sync-free dispatch: issue EVERY block solve before touching any
+        # result — no read-modify-write of ``coefs`` between dispatches, so
+        # consecutive blocks pipeline on device instead of serializing
+        # through the host.
+        results = []
         for i, block in enumerate(self.dataset.blocks):
             offs = block.gather_offsets(total_offset)
-            w0 = coefs[block.entity_idx]
-            w_new, iters, reasons = _solve_block(
-                block, offs, w0, self.objective, self.optimizer_spec, self._config,
-                self._feature_masks.get(i),
+            w0 = self._dense_warm_start(coefs, block, d)
+            mask = self._feature_masks.get(i)
+            solver = self.solve_cache.block_solver(
+                self._block_objectives[i], self.optimizer_spec, self._config,
+                has_mask=mask is not None,
             )
-            coefs = coefs.at[block.entity_idx].set(w_new)
-            iter_list.append(iters)
-            reason_list.append(reasons)
+            results.append((block, *solver(block, offs, w0, mask)))
+
+        # One scatter for the whole pass: per-block outputs (sliced back to
+        # the dataset width) concatenate and write once; shape-bucket
+        # padding rows target out-of-range row E and are dropped.
+        if results:
+            idx = jnp.concatenate(
+                [jnp.where(b.entity_idx >= 0, b.entity_idx, E) for b, *_ in results]
+            )
+            w_all = jnp.concatenate([w[:, :d] for _b, w, _i, _r in results])
+            coefs = coefs.at[idx].set(w_all.astype(coefs.dtype), mode="drop")
 
         variances = None
         if self.compute_variance != VarianceComputationType.NONE:
@@ -317,8 +412,23 @@ class RandomEffectCoordinate(Coordinate):
             coefs, self.dataset.config.re_type, self.dataset.config.feature_shard,
             self.task, variances,
         )
-        stats = self._tracker_stats(iter_list, reason_list)
+        stats = self._tracker_stats(
+            [(b.entity_idx, it, rs) for b, _w, it, rs in results]
+        )
         return model, stats
+
+    def _dense_warm_start(self, coefs: Array, block: EntityBlock, d: int) -> Array:
+        """Fresh (E_b, block.dim) warm-start buffer for a dense block.
+
+        Always a gather (never a view of a live model array), so the solver
+        cache may DONATE it; padded entity rows gather row 0 (inert:
+        ``train_mask=False`` keeps their output at the warm start, and the
+        final scatter drops them); padded feature columns warm-start at 0.
+        """
+        w0 = coefs[jnp.maximum(block.entity_idx, 0)]
+        if block.dim > d:
+            w0 = jnp.pad(w0, ((0, 0), (0, block.dim - d)))
+        return w0
 
     def _train_projected(
         self, total_offset: Array, initial_model
@@ -327,21 +437,27 @@ class RandomEffectCoordinate(Coordinate):
         ``d_full`` is ever materialized (model projection lives in the
         block's col_map)."""
         entity_block, entity_row, inv_maps = self.dataset.projection_tables()
-        iter_list, reason_list = [], []
-        block_coefs, block_vars, col_maps = [], [], []
+        parts = []
+        block_coefs, block_vars, col_maps, block_offs = [], [], [], []
+        # Sync-free dispatch: every block solve is issued before any
+        # dependent work (variances) touches the outputs.
         for i, block in enumerate(self.dataset.blocks):
             offs = block.gather_offsets(total_offset)
             w0 = self._initial_block_coefs(block, i, initial_model)
-            obj = self._block_objective(block)
-            w_new, iters, reasons = _solve_block(
-                block, offs, w0, obj, self.optimizer_spec, self._config,
-                self._feature_masks.get(i),
+            obj = self._block_objectives[i]
+            mask = self._feature_masks.get(i)
+            solver = self.solve_cache.block_solver(
+                obj, self.optimizer_spec, self._config, has_mask=mask is not None
             )
+            w_new, iters, reasons = solver(block, offs, w0, mask)
             block_coefs.append(w_new)
             col_maps.append(block.col_map)
-            iter_list.append(iters)
-            reason_list.append(reasons)
-            if self.compute_variance != VarianceComputationType.NONE:
+            block_offs.append(offs)
+            parts.append((block.entity_idx, iters, reasons))
+        if self.compute_variance != VarianceComputationType.NONE:
+            for i, block in enumerate(self.dataset.blocks):
+                obj = self._block_objectives[i]
+
                 def var_one(feat, lab, wt, off, w, _obj=obj):
                     lb = LabeledBatch(lab, feat, off, wt)
                     bn = _obj.normalization
@@ -354,7 +470,8 @@ class RandomEffectCoordinate(Coordinate):
 
                 block_vars.append(
                     jax.vmap(var_one)(
-                        block.features, block.label, block.weight, offs, w_new
+                        block.features, block.label, block.weight,
+                        block_offs[i], block_coefs[i],
                     )
                 )
         model = ProjectedRandomEffectModel(
@@ -373,20 +490,29 @@ class RandomEffectCoordinate(Coordinate):
                 else None
             ),
         )
-        return model, self._tracker_stats(iter_list, reason_list)
+        return model, self._tracker_stats(parts)
 
     def _initial_block_coefs(self, block, block_index: int, initial_model) -> Array:
-        """Warm-start coefficients in block space from either model form."""
+        """Warm-start coefficients in block space from either model form.
+
+        Always returns a buffer the caller exclusively owns (the solver
+        cache DONATES it): a same-shape projected warm start is copied
+        instead of aliased, so the caller's ``initial_model`` stays valid
+        after the donated solve.
+        """
         E_b, d_b = block.num_entities, block.dim
         if initial_model is None:
             return jnp.zeros((E_b, d_b), jnp.float32)
         if isinstance(initial_model, ProjectedRandomEffectModel):
             prev = initial_model.block_coefs[block_index]
             if prev.shape == (E_b, d_b):  # same dataset → same blocks
-                return prev
+                return jnp.copy(prev)
             initial_model = initial_model.to_dense()
-        # Dense (E, d_full) model: gather rows, project into block space.
-        return block.project_forward(initial_model.coefficients[block.entity_idx])
+        # Dense (E, d_full) model: gather rows, project into block space
+        # (a fresh gather — donation-safe; padded rows gather row 0, inert).
+        return block.project_forward(
+            initial_model.coefficients[jnp.maximum(block.entity_idx, 0)]
+        )
 
     def _block_variances(self, coefs: Array, total_offset: Array, dtype) -> Array:
         """Per-entity coefficient variances, SIMPLE or FULL, vmapped per block
@@ -394,41 +520,48 @@ class RandomEffectCoordinate(Coordinate):
         E, d = self.dataset.num_entities, self.dataset.dim
         variances = jnp.ones((E, d), dtype)
 
-        norm = self.objective.normalization
-        folded = norm is not None and not norm.is_identity
+        parts = []
+        for i, block in enumerate(self.dataset.blocks):
+            obj = self._block_objectives[i]
+            norm = obj.normalization
+            folded = norm is not None and not norm.is_identity
 
-        def var_one(feat, lab, wt, off, w):
-            lb = LabeledBatch(lab, feat, off, wt)
-            wv = norm.model_to_transformed_space(w) if folded else w
-            v = coefficient_variances(self.objective, wv, lb, self.compute_variance)
-            if folded and v is not None and norm.factors is not None:
-                v = v * norm.factors**2
-            return v
+            def var_one(feat, lab, wt, off, w, _obj=obj, _norm=norm, _folded=folded):
+                lb = LabeledBatch(lab, feat, off, wt)
+                wv = _norm.model_to_transformed_space(w) if _folded else w
+                v = coefficient_variances(_obj, wv, lb, self.compute_variance)
+                if _folded and v is not None and _norm.factors is not None:
+                    v = v * _norm.factors**2
+                return v
 
-        for block in self.dataset.blocks:
             offs = block.gather_offsets(total_offset)
             v = jax.vmap(var_one)(
-                block.features, block.label, block.weight, offs, coefs[block.entity_idx]
+                block.features, block.label, block.weight, offs,
+                self._dense_warm_start(coefs, block, d),
             )
-            variances = variances.at[block.entity_idx].set(v)
+            parts.append((block, v))
+        if parts:
+            idx = jnp.concatenate(
+                [jnp.where(b.entity_idx >= 0, b.entity_idx, E) for b, _v in parts]
+            )
+            v_all = jnp.concatenate([v[:, :d] for _b, v in parts])
+            variances = variances.at[idx].set(v_all.astype(dtype), mode="drop")
         return variances
 
     @staticmethod
-    def _tracker_stats(iter_list, reason_list) -> RandomEffectTrackerStats:
-        if not iter_list:
-            return RandomEffectTrackerStats(0, 0, 0, 0.0, 0)
-        iters = jnp.concatenate([jnp.ravel(x) for x in iter_list])
-        reasons = jnp.concatenate([jnp.ravel(x) for x in reason_list])
-        converged = jnp.sum(
-            (reasons == REASON_FUNCTION_VALUES_CONVERGED)
-            | (reasons == REASON_GRADIENT_CONVERGED)
-        )
+    def _tracker_stats(parts) -> RandomEffectTrackerStats:
+        """Assemble the on-device tracker from per-block
+        ``(entity_idx, iterations, reasons)`` triples — concatenations only,
+        NO device→host transfer (aggregates materialize in ``summary()``)."""
+        if not parts:
+            return RandomEffectTrackerStats.empty()
+        iters = jnp.concatenate([jnp.ravel(it) for _e, it, _r in parts])
+        reasons = jnp.concatenate([jnp.ravel(r) for _e, _i, r in parts])
+        valid = jnp.concatenate([jnp.ravel(e) >= 0 for e, _i, _r in parts])
         return RandomEffectTrackerStats(
-            num_entities=int(iters.shape[0]),
-            num_converged=int(converged),
-            num_max_iter=int(jnp.sum(reasons == REASON_MAX_ITERATIONS)),
-            mean_iterations=float(jnp.mean(iters.astype(jnp.float32))),
-            max_iterations=int(jnp.max(iters)),
+            iterations=iters.astype(jnp.int32),
+            reasons=reasons.astype(jnp.int32),
+            valid=valid,
         )
 
     def score(self, model, batch: GameBatch) -> Array:

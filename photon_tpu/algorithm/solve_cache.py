@@ -1,0 +1,293 @@
+"""Compile-once executable cache for the GLMix solver hot paths.
+
+The random-effect coordinate dispatches one vmapped ``_solve_block`` per
+EntityBlock per coordinate-descent pass — the paper's hot loop of millions
+of per-entity GLM solves (reference RandomEffectCoordinate.scala:228-283)
+collapsed into a handful of SPMD programs. Before this cache, every one of
+those dispatches re-traced the solver eagerly: K CD passes × B blocks ×
+S λ-sweep configs paid K·B·S traces for what is at most a few distinct
+(shape, objective, optimizer) combinations.
+
+This module keys ONE jitted executable per
+
+    (block shape bucket, dtype, static objective config, optimizer spec,
+     has feature mask)
+
+so repeated CD passes and repeated same-shape blocks reuse a single
+executable. Paired with shape bucketing (data/random_effect.py rounds
+``(E, n_max, d)`` up to a geometric grid), heterogeneous entity populations
+collapse onto a handful of cache entries. The warm-start coefficient buffer
+is donated (``donate_argnums``): the (E, d) warm start is dead after the
+solve, so XLA reuses its HBM for the output instead of allocating a second
+coefficient block.
+
+Key construction notes:
+
+- ``GLMObjective`` / ``OptimizerSpec`` / ``OptimizerConfig`` are keyed by
+  their static scalar fields. Normalization vectors and box-constraint
+  arrays are keyed by ``id()`` (they are built once per coordinate and
+  reused across passes); the cache pins a strong reference to every keyed
+  object so an id is never recycled while its entry is alive.
+- Trace counting is done INSIDE the traced function (the standard
+  trace-counter trick): the Python side effect runs only when JAX actually
+  traces, so ``stats.traces`` counts real retraces — including any the
+  jit-level cache would hide — and the retrace-regression test in
+  tests/test_solve_cache.py asserts on it directly.
+
+The same cache serves the fixed-effect objective (``fe_solver``): the full
+optimizer run over the sharded batch becomes one cached jitted program per
+(objective, spec) instead of an eager re-trace of the ``lax.while_loop``
+nest on every ``train()`` call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class SolveCacheStats:
+    """Counters for cache effectiveness, reported by bench.py.
+
+    traces:  executions of the tracing path (one per distinct executable;
+             a retrace of an existing key also counts — that is the point).
+    calls:   solver dispatches routed through the cache.
+    hits:    dispatches that reused an already-traced executable.
+    trace_keys: shape/kind descriptor recorded at each trace, for the
+             bench's retrace breakdown.
+    """
+
+    traces: int = 0
+    calls: int = 0
+    hits: int = 0
+    trace_keys: List[Tuple] = dataclasses.field(default_factory=list)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(
+            traces=self.traces,
+            calls=self.calls,
+            hits=self.hits,
+            trace_keys=[list(k) for k in self.trace_keys],
+        )
+
+
+def _scalar(x):
+    """Coerce a numeric config field to a hashable Python scalar; arrays and
+    other unhashables fall back to identity (pinned by the cache entry)."""
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    try:
+        hash(x)
+        return x
+    except TypeError:
+        return ("id", id(x))
+
+
+class SolveCache:
+    """Executable cache for block (random-effect) and fixed-effect solves.
+
+    One instance may be shared across coordinates — the module-level
+    :func:`default_cache` is shared by every coordinate that is not given an
+    explicit cache, so a λ-sweep over the same dataset hits one executable
+    set. ``donate=False`` disables warm-start donation (callers that need to
+    reuse the w0 buffer after the solve).
+    """
+
+    def __init__(self, donate: bool = True):
+        self.donate = donate
+        self.stats = SolveCacheStats()
+        self._fns: Dict[Tuple, Callable] = {}
+        self._pins: List[Tuple] = []  # keep id()-keyed objects alive
+        self._lock = threading.Lock()
+
+    # ---- static keys -----------------------------------------------------
+
+    @staticmethod
+    def _norm_key(norm) -> Optional[Tuple]:
+        if norm is None:
+            return None
+        return (
+            bool(norm.is_identity),
+            None if norm.factors is None else ("id", id(norm.factors)),
+            None if norm.shifts is None else ("id", id(norm.shifts)),
+            _scalar(getattr(norm, "intercept_index", None)),
+        )
+
+    @classmethod
+    def _objective_key(cls, objective) -> Tuple:
+        return (
+            objective.loss,
+            _scalar(objective.l2_weight),
+            _scalar(objective.l1_weight),
+            _scalar(objective.intercept_index),
+            bool(objective.use_pallas),
+            cls._norm_key(objective.normalization),
+        )
+
+    @staticmethod
+    def _spec_key(spec) -> Tuple:
+        return (
+            spec.optimizer,
+            _scalar(spec.max_iter),
+            _scalar(spec.tol),
+            _scalar(spec.memory),
+            _scalar(spec.max_cg_iter),
+            None
+            if spec.box is None
+            else (("id", id(spec.box[0])), ("id", id(spec.box[1]))),
+            bool(spec.track_history),
+        )
+
+    @staticmethod
+    def _config_key(config) -> Tuple:
+        return (
+            _scalar(config.max_iter),
+            _scalar(config.tol),
+            _scalar(config.memory),
+            _scalar(config.max_line_search_evals),
+            bool(config.track_history),
+        )
+
+    # ---- builders --------------------------------------------------------
+
+    def _get_or_build(self, key: Tuple, build: Callable[[], Callable], pins: Tuple):
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = build()
+                self._fns[key] = fn
+                self._pins.append(pins)
+        return fn
+
+    def _counted(self, fn: Callable) -> Callable:
+        """Wrap a jitted fn with hit/call accounting (trace accounting lives
+        inside the traced body, so it also catches shape-driven retraces)."""
+
+        def call(*args):
+            before = self.stats.traces
+            out = fn(*args)
+            self.stats.calls += 1
+            if self.stats.traces == before:
+                self.stats.hits += 1
+            return out
+
+        return call
+
+    def block_solver(
+        self, objective, spec, config, has_mask: bool
+    ) -> Callable[..., Tuple[Array, Array, Array]]:
+        """Jitted ``_solve_block`` executable for one static configuration.
+
+        Returns ``solve(block, offsets, w0[, feature_mask])``. The warm
+        start ``w0`` is DONATED (when ``self.donate``): callers must pass a
+        buffer that is dead after the call — a fresh gather, or an explicit
+        copy of any model-owned array.
+        """
+        has_mask = bool(has_mask)
+        key = (
+            "block",
+            self._objective_key(objective),
+            self._spec_key(spec),
+            self._config_key(config),
+            has_mask,
+        )
+
+        def build():
+            from photon_tpu.algorithm.random_effect import _solve_block
+
+            stats = self.stats
+
+            if has_mask:
+
+                def traced(block, offsets, w0, feature_mask):
+                    stats.traces += 1
+                    stats.trace_keys.append(
+                        ("block",) + tuple(block.features.shape) + (has_mask,)
+                    )
+                    return _solve_block(
+                        block, offsets, w0, objective, spec, config, feature_mask
+                    )
+
+            else:
+
+                def traced(block, offsets, w0):
+                    stats.traces += 1
+                    stats.trace_keys.append(
+                        ("block",) + tuple(block.features.shape) + (has_mask,)
+                    )
+                    return _solve_block(block, offsets, w0, objective, spec, config)
+
+            donate = (2,) if self.donate else ()
+            return jax.jit(traced, donate_argnums=donate)
+
+        fn = self._get_or_build(key, build, (objective, spec, config))
+        counted = self._counted(fn)
+        if has_mask:
+            return counted
+
+        def call(block, offsets, w0, feature_mask=None):
+            assert feature_mask is None
+            return counted(block, offsets, w0)
+
+        return call
+
+    def fe_solver(self, objective, spec) -> Callable:
+        """Jitted fixed-effect solve ``(w0, labeled_batch) -> OptimizeResult``
+        for one (objective, spec). The batch is a traced argument, so the
+        one cache entry serves every batch of the same structure; w0 is NOT
+        donated here (fixed-effect warm starts alias live model buffers)."""
+        key = ("fe", self._objective_key(objective), self._spec_key(spec))
+
+        def build():
+            from photon_tpu.optim.factory import make_optimizer
+
+            solve = make_optimizer(objective, spec)
+            stats = self.stats
+
+            def traced(w0, lb):
+                stats.traces += 1
+                stats.trace_keys.append(("fe", int(w0.shape[0])))
+                return solve(w0, lb)
+
+            return jax.jit(traced)
+
+        fn = self._get_or_build(key, build, (objective, spec))
+        return self._counted(fn)
+
+    # ---- introspection ---------------------------------------------------
+
+    @property
+    def num_entries(self) -> int:
+        return len(self._fns)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._fns.clear()
+            self._pins.clear()
+            self.stats = SolveCacheStats()
+
+
+_default_cache = SolveCache()
+
+
+def default_cache() -> SolveCache:
+    """The process-wide cache shared by coordinates without an explicit one."""
+    return _default_cache
+
+
+def reset_default_cache(donate: bool = True) -> SolveCache:
+    """Replace the shared cache (tests / benchmark A-B sections)."""
+    global _default_cache
+    _default_cache = SolveCache(donate=donate)
+    return _default_cache
+
+
+def cache_stats() -> Dict[str, Any]:
+    """Shared-cache counters, in the shape bench.py reports."""
+    return _default_cache.stats.as_dict()

@@ -37,6 +37,7 @@ from photon_tpu.data.normalization import build_normalization_context
 from photon_tpu.data.stats import compute_feature_stats
 from photon_tpu.data.index_map import IndexMap
 from photon_tpu.estimators.game_estimator import GameEstimator
+from photon_tpu.evaluation.metrics_map import sanitize_for_json
 from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
 from photon_tpu.io.data_reader import read_merged
 from photon_tpu.io.model_io import load_game_model, save_game_model
@@ -406,7 +407,9 @@ def run(args) -> Dict:
             eidx.save(os.path.join(args.output_dir, f"entity-index-{re_type}.json"))
     summary["best"] = {"config": best.config.describe(), "metrics": best.metrics}
     with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
-        json.dump(summary, f, indent=2)
+        # Non-finite metrics (e.g. AIC at the n−k−1=0 pole) become null:
+        # the bare token Infinity is not RFC-8259 JSON.
+        json.dump(sanitize_for_json(summary), f, indent=2)
     emitter.emit(
         training_finish_event(best=None if best is None else best.config.describe())
     )

@@ -25,7 +25,11 @@ from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.index_map import IndexMap
 from photon_tpu.data.normalization import build_normalization_context
 from photon_tpu.data.stats import compute_feature_stats
-from photon_tpu.evaluation.metrics_map import metrics_map, selection_metric
+from photon_tpu.evaluation.metrics_map import (
+    metrics_map,
+    sanitize_for_json,
+    selection_metric,
+)
 from photon_tpu.io.data_reader import FeatureShardConfig, read_merged
 from photon_tpu.io.libsvm import read_libsvm
 from photon_tpu.io.model_io import save_game_model
@@ -385,7 +389,9 @@ def run(args) -> Dict:
         "stage": stage.name,
     }
     with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
-        json.dump(summary, f, indent=2)
+        # Non-finite metrics (e.g. AIC at the n−k−1=0 pole) become null:
+        # the bare token Infinity is not RFC-8259 JSON.
+        json.dump(sanitize_for_json(summary), f, indent=2)
     emitter.emit(training_finish_event(best_lambda=best["lambda"]))
     return summary
 

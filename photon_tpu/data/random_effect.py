@@ -40,6 +40,25 @@ from photon_tpu.data.batch import LabeledBatch
 Array = jax.Array
 
 
+def bucket_dim(x: int) -> int:
+    """Round a block dimension UP to the geometric shape-bucket grid
+    {1, 2, 3, 4, 6, 8, 12, 16, 24, 32, ...} (powers of two and 1.5×).
+
+    Grid ratio ≤ 4/3 bounds per-dim padding waste at ~33% while collapsing
+    heterogeneous entity populations onto a handful of block shapes, so the
+    compiled-solver cache (algorithm/solve_cache.py) traces once per bucket
+    instead of once per exact shape. Padding carries zero weight (samples)
+    and ``train_mask=False`` / ``entity_idx=-1`` (entities), so results are
+    bit-for-bit decoupled from real rows up to reduction order."""
+    x = int(x)
+    if x <= 2:
+        return max(x, 1)
+    p = 1 << (x - 1).bit_length()  # next power of two ≥ x
+    if 3 * (p // 4) >= x:
+        return 3 * (p // 4)  # 1.5 × previous power of two
+    return p
+
+
 def _byteswap64(x: np.ndarray) -> np.ndarray:
     """Deterministic sampling key (role of Spark's byteswap64 hash,
     RandomEffectDataset.scala:517-524)."""
@@ -70,6 +89,15 @@ class RandomEffectDataConfig:
     active_lower_bound: Optional[int] = None  # lower bound on #samples/entity
     features_to_samples_ratio: Optional[float] = None  # Pearson selection cap
     n_buckets: int = 4  # blocks with distinct n_max to bound padding waste
+    # Round block shapes (E, n_max, d) UP to the geometric bucket grid (see
+    # ``bucket_dim``) so heterogeneous entity populations collapse onto a
+    # handful of cached solver executables (algorithm/solve_cache.py).
+    # Padding rows carry zero weight; padded entities carry
+    # ``train_mask=False`` and ``entity_idx=-1``. The feature dim is
+    # bucketed for dense shards only — a projected block's col_map is
+    # content-defined and must stay exact (model I/O maps its columns back
+    # to global feature names).
+    shape_bucketing: bool = True
     # Per-block feature-subspace compaction (reference
     # LinearSubspaceProjector.scala:36-88 / RandomEffectDataset.scala:383-432,
     # vmap-granularity: the union of a BLOCK's active columns instead of one
@@ -83,13 +111,16 @@ class RandomEffectDataConfig:
 class EntityBlock:
     """One fixed-shape block of per-entity problems (vmap unit).
 
-    entity_idx: (E,) dense entity index of each row.
+    entity_idx: (E,) dense entity index of each row; -1 marks a shape-bucket
+      padding row (no entity — excluded from tracker stats and dropped at
+      scatter time).
     features:   (E, n_max, d)
     label/weight: (E, n_max); padding samples have weight 0.
     sample_index: (E, n_max) int32 row into the flat GameBatch (-1 padding);
       used to gather residual offsets and scatter scores.
     train_mask: (E,) bool — False for entities filtered by the lower bound
-      (they keep a zero model; reference filterActiveData:550-570).
+      (they keep a zero model; reference filterActiveData:550-570) and for
+      shape-bucket padding rows.
     """
 
     entity_idx: Array
@@ -167,8 +198,9 @@ class RandomEffectDataset:
         inv_maps = []
         for b, block in enumerate(self.blocks):
             eidx = np.asarray(block.entity_idx)
-            entity_block[eidx] = b
-            entity_row[eidx] = np.arange(eidx.size, dtype=np.int32)
+            real = eidx >= 0  # skip shape-bucket padding rows
+            entity_block[eidx[real]] = b
+            entity_row[eidx[real]] = np.arange(eidx.size, dtype=np.int32)[real]
             inv = np.full((self.dim,), -1, np.int32)
             if block.col_map is not None:
                 inv[np.asarray(block.col_map)] = np.arange(block.dim, dtype=np.int32)
@@ -287,12 +319,23 @@ def build_random_effect_dataset(
             inv_map[col_map] = np.arange(col_map.size)
         d_block = int(col_map.size) if project else d
 
-        feat = np.zeros((E, n_max, d_block), dtype=feat_dtype)
-        lab = np.zeros((E, n_max), dtype=label.dtype)
-        wt = np.zeros((E, n_max), dtype=weight.dtype)
-        sidx = np.full((E, n_max), -1, dtype=np.int32)
-        eidx = np.empty((E,), dtype=np.int32)
-        tmask = np.empty((E,), dtype=bool)
+        # Shape bucketing: round (E, n_max, d) up to the geometric grid so
+        # the solver cache keys collapse; padding is inert by construction
+        # (weight 0, train_mask False, entity_idx −1). Projected blocks keep
+        # their exact content-defined col_map width.
+        E_alloc = E
+        if config.shape_bucketing:
+            n_max = bucket_dim(n_max)
+            E_alloc = bucket_dim(E)
+            if not project:
+                d_block = bucket_dim(d_block)
+
+        feat = np.zeros((E_alloc, n_max, d_block), dtype=feat_dtype)
+        lab = np.zeros((E_alloc, n_max), dtype=label.dtype)
+        wt = np.zeros((E_alloc, n_max), dtype=weight.dtype)
+        sidx = np.full((E_alloc, n_max), -1, dtype=np.int32)
+        eidx = np.full((E_alloc,), -1, dtype=np.int32)
+        tmask = np.zeros((E_alloc,), dtype=bool)
         for j, gi in enumerate(sel):
             eid, rows = entities[gi]
             m = len(rows)
@@ -306,7 +349,8 @@ def build_random_effect_dataset(
             elif project:
                 feat[j, :m] = features[rows][:, col_map]
             else:
-                feat[j, :m] = features[rows]
+                # d_block ≥ d under bucketing; padded columns stay zero.
+                feat[j, :m, :d] = features[rows]
             lab[j, :m] = label[rows]
             wt[j, :m] = weight[rows]
             sidx[j, :m] = rows

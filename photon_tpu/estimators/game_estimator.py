@@ -38,7 +38,11 @@ from photon_tpu.estimators.config import (
     expand_optimization_configs,
 )
 from photon_tpu.evaluation.suite import EvaluationSuite
-from photon_tpu.models.game import GameModel
+from photon_tpu.models.game import (
+    GameModel,
+    ProjectedRandomEffectModel,
+    RandomEffectModel,
+)
 from photon_tpu.ops.losses import loss_for_task
 from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.ops.variance import normalize_variance_type
@@ -49,6 +53,31 @@ from photon_tpu.utils.timed import Timed
 logger = logging.getLogger(__name__)
 
 CoordinateConfig = Union[FixedEffectCoordinateConfig, RandomEffectCoordinateConfig]
+
+
+def _existing_entity_mask(prev_model) -> np.ndarray:
+    """(E,) bool — which entities the warm-start model has a record for.
+
+    Presence means record membership (reference
+    RandomEffectDataset.scala:550-570), never coefficient values: an
+    all-zero L1-sparsified row is still an EXISTING model and must keep the
+    active-data bound. The loader's ``present_entities`` mask is
+    authoritative when set; a projected model's presence is entity_block ≥ 0
+    (entities with no block never had data or a model); a dense in-memory
+    model without the mask treats every row as existing.
+    """
+    pm = getattr(prev_model, "present_entities", None)
+    if pm is not None:
+        return np.asarray(pm, bool)
+    if isinstance(prev_model, ProjectedRandomEffectModel):
+        return np.asarray(prev_model.entity_block) >= 0
+    if isinstance(prev_model, RandomEffectModel):
+        return np.ones((prev_model.num_entities,), bool)
+    raise TypeError(
+        "warm-start model for a random-effect coordinate must be a "
+        "RandomEffectModel or ProjectedRandomEffectModel, got "
+        f"{type(prev_model).__name__}"
+    )
 
 
 @dataclasses.dataclass
@@ -210,13 +239,9 @@ class GameEstimator:
                     existing = np.zeros((E,), bool)
                     prev_model = self.warm_start_model.get(cfg.coordinate_id)
                     if prev_model is not None:
-                        pm = getattr(prev_model, "present_entities", None)
-                        src = (np.asarray(pm) if pm is not None
-                               else np.any(
-                                   np.asarray(prev_model.coefficients) != 0.0,
-                                   axis=1))
-                        k = min(E, src.shape[0])
-                        existing[:k] = src[:k]
+                        existing_src = _existing_entity_mask(prev_model)
+                        k = min(E, existing_src.shape[0])
+                        existing[:k] = existing_src[:k]
                 self._re_datasets[cfg.coordinate_id] = build_random_effect_dataset(
                     eids,
                     feats_np[cfg.feature_shard],

@@ -104,9 +104,15 @@ def metrics_map(
         out[ROOT_MEAN_SQUARE_ERROR] = float(jnp.sqrt(mse))
 
     if task in _BINARY_TASKS:
-        out[AREA_UNDER_PRECISION_RECALL] = float(auc_pr(preds, labels))
-        out[AREA_UNDER_ROC] = float(auc_roc(preds, labels))
-        out[PEAK_F1_SCORE] = float(peak_f1(preds, labels))
+        # Rank metrics see the MARGINS, not the sigmoid means: the mean
+        # function is monotone so AUROC/AUPR/peak-F1 are identical in exact
+        # arithmetic, but f32 sigmoid saturates to exactly 0/1 beyond
+        # |margin| ≈ 17, creating artificial ties that can flip model
+        # selection between near-identical sweeps.
+        scores = jnp.asarray(margins, jnp.float32)
+        out[AREA_UNDER_PRECISION_RECALL] = float(auc_pr(scores, labels))
+        out[AREA_UNDER_ROC] = float(auc_roc(scores, labels))
+        out[PEAK_F1_SCORE] = float(peak_f1(scores, labels))
 
     ll = _log_likelihood_per_datum(task, margins, preds, labels)
     if ll is not None:
@@ -122,6 +128,23 @@ def metrics_map(
             corr = math.inf if den == 0 else 2.0 * k * (k + 1) / den
             out[AKAIKE_INFORMATION_CRITERION] = base_aic + corr
     return out
+
+
+def sanitize_for_json(obj):
+    """Recursively replace non-finite floats with None for serialization.
+
+    The in-memory MetricsMap keeps Scala-double parity (AIC can be
+    ``math.inf`` at the n−k−1 = 0 pole), but ``json.dump`` would emit the
+    non-RFC token ``Infinity`` that strict parsers reject — training
+    summaries sanitize through this helper at write time instead.
+    """
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: sanitize_for_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_for_json(v) for v in obj]
+    return obj
 
 
 # ModelSelection.scala:36-63 — (metric name, larger_is_better) per task.

@@ -151,21 +151,29 @@ class ProjectedRandomEffectModel:
         """Materialize the global-space (E, d_full) model (small shards,
         tests, interoperability). The wide-shard I/O path iterates blocks
         directly instead (io/model_io.py)."""
-        coefs = jnp.zeros((self.num_entities, self.d_full), jnp.float32)
+        E = self.num_entities
+        coefs = jnp.zeros((E, self.d_full), jnp.float32)
         variances = None
         for b, (wb, cmap) in enumerate(zip(self.block_coefs, self.col_maps)):
-            rows = jnp.flatnonzero(self.entity_block == b, size=wb.shape[0])
+            # A shape-bucketed block may hold fewer than E_b real entities:
+            # fill the overflow with out-of-range row E and drop it at the
+            # scatter (fill 0 would silently clobber entity 0's model).
+            rows = jnp.flatnonzero(
+                self.entity_block == b, size=wb.shape[0], fill_value=E
+            )
             coefs = coefs.at[rows[:, None], cmap[None, :]].set(
-                wb[self.entity_row[rows]]
+                wb[self.entity_row[jnp.minimum(rows, E - 1)]], mode="drop"
             )
         if self.block_variances is not None:
-            variances = jnp.ones((self.num_entities, self.d_full), jnp.float32)
+            variances = jnp.ones((E, self.d_full), jnp.float32)
             for b, (vb, cmap) in enumerate(
                 zip(self.block_variances, self.col_maps)
             ):
-                rows = jnp.flatnonzero(self.entity_block == b, size=vb.shape[0])
+                rows = jnp.flatnonzero(
+                    self.entity_block == b, size=vb.shape[0], fill_value=E
+                )
                 variances = variances.at[rows[:, None], cmap[None, :]].set(
-                    vb[self.entity_row[rows]]
+                    vb[self.entity_row[jnp.minimum(rows, E - 1)]], mode="drop"
                 )
         return RandomEffectModel(
             coefs, self.re_type, self.feature_shard, self.task, variances

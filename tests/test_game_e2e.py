@@ -373,3 +373,47 @@ def test_ignore_threshold_requires_warm_start_model():
             ],
             ignore_threshold_for_new_models=True,
         )
+
+
+def test_existing_entity_mask_model_types():
+    """Warm-start presence semantics (reference key-presence,
+    RandomEffectDataset.scala:550-570): projected models report presence by
+    entity_block >= 0 (no AttributeError), dense models without a loader
+    mask treat every row as existing (an all-zero L1-sparsified row is NOT
+    'new'), present_entities wins when set, and unknown model types raise
+    a descriptive TypeError."""
+    from photon_tpu.estimators.game_estimator import _existing_entity_mask
+    from photon_tpu.models.game import (
+        ProjectedRandomEffectModel, RandomEffectModel,
+    )
+
+    proj = ProjectedRandomEffectModel(
+        block_coefs=[jnp.zeros((2, 3), jnp.float32)],
+        col_maps=[jnp.arange(3, dtype=jnp.int32)],
+        inv_maps=[jnp.arange(3, dtype=jnp.int32)],
+        entity_block=jnp.asarray([0, -1, 0], jnp.int32),
+        entity_row=jnp.asarray([0, 0, 1], jnp.int32),
+        d_full=3, re_type="userId", feature_shard="re",
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+    np.testing.assert_array_equal(
+        _existing_entity_mask(proj), [True, False, True]
+    )
+
+    dense = RandomEffectModel(
+        jnp.asarray([[0.0, 0.0], [1.0, 0.0]], jnp.float32),  # row 0 L1-zeroed
+        "userId", "re", TaskType.LOGISTIC_REGRESSION,
+    )
+    np.testing.assert_array_equal(_existing_entity_mask(dense), [True, True])
+
+    with_mask = RandomEffectModel(
+        jnp.zeros((3, 2), jnp.float32), "userId", "re",
+        TaskType.LOGISTIC_REGRESSION,
+        present_entities=jnp.asarray([True, False, True]),
+    )
+    np.testing.assert_array_equal(
+        _existing_entity_mask(with_mask), [True, False, True]
+    )
+
+    with pytest.raises(TypeError, match="RandomEffectModel"):
+        _existing_entity_mask(object())

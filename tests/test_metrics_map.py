@@ -129,3 +129,41 @@ def test_selection_metric_directions():
         DATA_LOG_LIKELIHOOD, True)
     assert selection_metric(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM) == (
         AREA_UNDER_ROC, True)
+
+
+def test_rank_metrics_immune_to_f32_sigmoid_saturation():
+    """Margins beyond ±17 saturate f32 sigmoid to exactly 0/1, creating
+    artificial ties that flip AUROC between models that rank differently.
+    The rank metrics must see margins (rank-equivalent), not the means."""
+    # Model A ranks perfectly; its margins are deep in saturation.
+    margins = np.array([40.0, 30.0, 25.0, 20.0, -20.0, -30.0], np.float32)
+    labels = np.array([1, 1, 1, 0, 0, 0], np.float32)
+    m = metrics_map(TaskType.LOGISTIC_REGRESSION, margins, labels)
+    # Sigmoid scores saturate to (1,1,1,1,0,0): the tied positive/negative
+    # pair costs half credit (AUROC 17/18 < 1). Margins rank cleanly.
+    assert m[AREA_UNDER_ROC] == pytest.approx(1.0, abs=1e-6)
+    assert m[PEAK_F1_SCORE] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_sanitize_for_json_nulls_nonfinite():
+    import json
+
+    from photon_tpu.evaluation.metrics_map import sanitize_for_json
+
+    summary = {
+        "metrics": {AKAIKE_INFORMATION_CRITERION: math.inf, "auc": 0.9},
+        "history": [1.0, -math.inf, float("nan")],
+        "nested": ({"x": math.nan},),
+        "label": "run-1",
+        "n": 7,
+    }
+    clean = sanitize_for_json(summary)
+    text = json.dumps(clean)  # must be RFC-8259 (no Infinity/NaN tokens)
+    assert "Infinity" not in text and "NaN" not in text
+    assert clean["metrics"][AKAIKE_INFORMATION_CRITERION] is None
+    assert clean["metrics"]["auc"] == 0.9
+    assert clean["history"] == [1.0, None, None]
+    assert clean["nested"] == [{"x": None}]
+    assert clean["label"] == "run-1" and clean["n"] == 7
+    # In-memory map keeps the Scala-parity float (sanitize is copy-only).
+    assert math.isinf(summary["metrics"][AKAIKE_INFORMATION_CRITERION])

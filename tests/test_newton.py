@@ -287,15 +287,16 @@ def test_solve_block_tron_masked_and_unmasked():
         RandomEffectDataConfig(re_type="u", feature_shard="re", n_buckets=1),
     )
     (block,) = ds.blocks
+    d_b = block.dim  # may exceed d under shape bucketing (padded zero cols)
     obj = GLMObjective(loss=LogisticLoss, l2_weight=0.8, intercept_index=0)
     cfg = OptimizerConfig(max_iter=25, tol=1e-8, track_history=False)
     offs = block.gather_offsets(jnp.zeros(N, jnp.float32))
-    w0 = jnp.zeros((block.num_entities, d), jnp.float32)
+    w0 = jnp.zeros((block.num_entities, d_b), jnp.float32)
     spec = OptimizerSpec(optimizer=OptimizerType.TRON)
 
     # Pearson-style mask: knock out a different column per entity (never
     # the intercept), plus some entities fully unmasked.
-    mask = np.ones((block.num_entities, d), np.float32)
+    mask = np.ones((block.num_entities, d_b), np.float32)
     for e in range(block.num_entities // 2):
         mask[e, 1 + (e % (d - 1))] = 0.0
     mask_j = jnp.asarray(mask)
@@ -317,7 +318,7 @@ def test_solve_block_tron_masked_and_unmasked():
             return res.w * fm
 
         fm_all = (
-            jnp.ones((block.num_entities, d), jnp.float32)
+            jnp.ones((block.num_entities, d_b), jnp.float32)
             if fmask_arg is None
             else fmask_arg
         )

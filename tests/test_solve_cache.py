@@ -1,0 +1,249 @@
+"""Compiled-solver cache (algorithm/solve_cache.py): retrace-count
+regression, shape bucketing, bucketed-vs-exact parity, warm-start donation
+safety, and the sync-free CoordinateDescent.run(profile=...) contract."""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from photon_tpu.algorithm.random_effect import (
+    RandomEffectCoordinate,
+    _solve_block,
+)
+from photon_tpu.algorithm.solve_cache import SolveCache
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.random_effect import (
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+    bucket_dim,
+)
+from photon_tpu.ops.losses import LogisticLoss
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.common import OptimizerConfig
+from photon_tpu.optim.factory import OptimizerSpec
+from photon_tpu.types import OptimizerType, TaskType
+
+E, D = 48, 5
+rng = np.random.default_rng(11)
+
+
+def _clustered_problem(dtype=np.float32):
+    """Entity sample counts in one bucket window, sized so the quantile
+    grouping yields THREE 12-entity blocks whose EXACT (E, n_max) differ —
+    (12,40,·), (12,43,·), (12,46,·) — but whose bucketed shapes coincide at
+    (12, 48, ·). The last 12 of the E entities carry no data (their rows
+    stay zero in every trained model)."""
+    counts = np.concatenate([
+        np.repeat([37, 40], 6), np.repeat([43, 46], 12), np.zeros(12, int)
+    ])
+    eids = np.repeat(np.arange(E, dtype=np.int32), counts)
+    n = eids.size
+    X = rng.normal(size=(n, D)).astype(dtype)
+    X[:, 0] = 1.0
+    y = (rng.uniform(size=n) < 0.5).astype(dtype)
+    w = np.ones(n, dtype)
+    return eids, X, y, w
+
+
+def _dataset(eids, X, y, w, bucketed=True, n_buckets=4):
+    return build_random_effect_dataset(
+        eids, X, y, w, E,
+        RandomEffectDataConfig(
+            re_type="userId", feature_shard="re", n_buckets=n_buckets,
+            shape_bucketing=bucketed, subspace_projection=False,
+        ),
+    )
+
+
+def _batch(eids, X, y, w):
+    return GameBatch(
+        label=jnp.asarray(y),
+        offset=jnp.zeros(y.shape[0], jnp.asarray(y).dtype),
+        weight=jnp.asarray(w),
+        features={"re": jnp.asarray(X)},
+        entity_ids={"userId": jnp.asarray(eids)},
+    )
+
+
+def _coordinate(ds, cache, **spec_kw):
+    spec = OptimizerSpec(
+        optimizer=OptimizerType.NEWTON, max_iter=25, tol=1e-9, **spec_kw
+    )
+    return RandomEffectCoordinate(
+        coordinate_id="per_user",
+        dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        objective=GLMObjective(loss=LogisticLoss, l2_weight=0.5,
+                               intercept_index=0),
+        optimizer_spec=spec,
+        solve_cache=cache,
+    )
+
+
+def test_bucket_dim_grid():
+    # Powers of two ∪ 1.5× powers of two, ratio ≤ 4/3, identity below 3.
+    assert [bucket_dim(x) for x in [1, 2, 3, 4, 5, 6, 7, 8, 9]] == \
+        [1, 2, 3, 4, 6, 6, 8, 8, 12]
+    # Worst-case rounding waste is the 2^k → 1.5·2^k step (ratio 1.5).
+    for x in [17, 33, 49, 97, 1000]:
+        b = bucket_dim(x)
+        assert b >= x and b / x <= 1.5 + 1e-9
+
+
+def test_retrace_once_per_bucket_across_passes():
+    """≥3 same-bucket blocks over ≥3 CD passes: the solver traces exactly
+    once per (bucket, objective-config) key; every other dispatch is a
+    cache hit (the ISSUE acceptance criterion)."""
+    eids, X, y, w = _clustered_problem()
+    ds = _dataset(eids, X, y, w, bucketed=True, n_buckets=4)
+    assert len(ds.blocks) >= 3  # ≥3 same-bucket blocks (the criterion)
+    shapes = {tuple(b.features.shape) for b in ds.blocks}
+    assert len(shapes) == 1, "clustered counts must collapse to one bucket"
+
+    cache = SolveCache(donate=True)
+    coord = _coordinate(ds, cache)
+    batch = _batch(eids, X, y, w)
+    model = None
+    passes = 3
+    for _ in range(passes):
+        model, _stats = coord.train(batch, None, model)
+
+    n_calls = passes * len(ds.blocks)
+    assert cache.stats.calls == n_calls
+    # One executable for the whole run: one bucket shape × one config.
+    assert cache.stats.traces == 1
+    assert cache.stats.hits == n_calls - 1
+    assert len(set(cache.stats.trace_keys)) == 1
+
+
+def test_exact_shapes_trace_per_block():
+    """Without bucketing the same data costs one trace per distinct block
+    shape — the regression the cache+bucketing pair exists to prevent."""
+    eids, X, y, w = _clustered_problem()
+    ds = _dataset(eids, X, y, w, bucketed=False, n_buckets=4)
+    shapes = {tuple(b.features.shape) for b in ds.blocks}
+    cache = SolveCache(donate=True)
+    coord = _coordinate(ds, cache)
+    batch = _batch(eids, X, y, w)
+    model = None
+    for _ in range(2):
+        model, _stats = coord.train(batch, None, model)
+    assert cache.stats.traces == len(shapes)
+    assert cache.stats.hits == cache.stats.calls - len(shapes)
+
+
+def test_bucketed_vs_exact_parity_f64():
+    """Bucketed solves match exact-shape solves at rtol ≤ 1e-6. Run in f64:
+    padding changes XLA reduction trees, so f32 carries trajectory-rounding
+    noise that is not a property of bucketing itself."""
+    jax.config.update("jax_enable_x64", True)
+    try:
+        eids, X, y, w = _clustered_problem(dtype=np.float64)
+        batch = _batch(eids, X, y, w)
+        models = {}
+        for bucketed in (True, False):
+            ds = _dataset(eids, X, y, w, bucketed=bucketed)
+            coord = _coordinate(ds, SolveCache(donate=True))
+            model = None
+            for _ in range(2):
+                model, _stats = coord.train(batch, None, model)
+            models[bucketed] = np.asarray(model.coefficients)[:E, :D]
+        np.testing.assert_allclose(
+            models[True], models[False], rtol=1e-6, atol=1e-12
+        )
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_donation_safety():
+    """The warm-start buffer is donated to the cached executable: it must be
+    consumed (deleted) after the call, the result must match the eager
+    un-donated solve, and a later dispatch must not disturb the first
+    result (nothing reads w0 after donation)."""
+    eids, X, y, w = _clustered_problem()
+    ds = _dataset(eids, X, y, w, bucketed=True, n_buckets=2)
+    block = ds.blocks[0]
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.5, intercept_index=0)
+    spec = OptimizerSpec(optimizer=OptimizerType.NEWTON, max_iter=25, tol=1e-9)
+    cfg = dataclasses.replace(spec.config(), track_history=False)
+    offs = block.gather_offsets(jnp.zeros(y.shape[0], jnp.float32))
+
+    cache = SolveCache(donate=True)
+    solve = cache.block_solver(obj, spec, cfg, has_mask=False)
+    w0 = jnp.zeros((block.num_entities, block.dim), jnp.float32)
+    w_cached, _it, _rs = solve(block, offs, w0)
+    assert w0.is_deleted(), "donated warm start must be consumed"
+
+    w0_eager = jnp.zeros((block.num_entities, block.dim), jnp.float32)
+    w_eager, _, _ = _solve_block(block, offs, w0_eager, obj, spec, cfg)
+    np.testing.assert_allclose(
+        np.asarray(w_cached), np.asarray(w_eager), rtol=1e-5, atol=1e-6
+    )
+
+    # Second dispatch through the same executable: first result unchanged.
+    before = np.asarray(w_cached).copy()
+    solve(block, offs, jnp.ones((block.num_entities, block.dim), jnp.float32))
+    np.testing.assert_array_equal(before, np.asarray(w_cached))
+
+    # donate=False leaves the caller's buffer alive.
+    cache_nd = SolveCache(donate=False)
+    solve_nd = cache_nd.block_solver(obj, spec, cfg, has_mask=False)
+    w0_kept = jnp.zeros((block.num_entities, block.dim), jnp.float32)
+    solve_nd(block, offs, w0_kept)
+    assert not w0_kept.is_deleted()
+
+
+def test_warm_start_survives_donation_end_to_end():
+    """Training twice with a warm-start model must not invalidate the
+    model passed in (the coordinate gathers a fresh w0 buffer; the model's
+    own coefficients are never donated)."""
+    eids, X, y, w = _clustered_problem()
+    ds = _dataset(eids, X, y, w, bucketed=True)
+    coord = _coordinate(ds, SolveCache(donate=True))
+    batch = _batch(eids, X, y, w)
+    m1, _ = coord.train(batch)
+    keep = np.asarray(m1.coefficients).copy()
+    coord.train(batch, None, m1)
+    assert not m1.coefficients.is_deleted()
+    np.testing.assert_array_equal(keep, np.asarray(m1.coefficients))
+
+
+def test_profile_flag_controls_sync(monkeypatch):
+    """run(profile=False) performs ZERO block_until_ready calls between
+    coordinate updates; profile=True keeps the timing sync (the default)."""
+    from photon_tpu.algorithm.coordinate_descent import CoordinateDescent
+
+    eids, X, y, w = _clustered_problem()
+    ds = _dataset(eids, X, y, w, bucketed=True)
+    batch = _batch(eids, X, y, w)
+
+    calls = {"n": 0}
+    real = jax.block_until_ready
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting)
+
+    def run(profile):
+        coord = _coordinate(ds, SolveCache(donate=True))
+        cd = CoordinateDescent(
+            coordinates={"per_user": coord},
+            update_sequence=["per_user"],
+            num_iterations=2,
+        )
+        calls["n"] = 0
+        return cd.run(batch, profile=profile)
+
+    res = run(profile=False)
+    assert calls["n"] == 0
+    # Wall times still recorded (dispatch-only) and the model trains.
+    assert all(t >= 0 for t in res.wall_times["per_user"])
+
+    res = run(profile=True)
+    assert calls["n"] >= 2  # one sync per coordinate update
+    assert all(t > 0 for t in res.wall_times["per_user"])
