@@ -651,6 +651,168 @@ def run_solve_cache_ab():
     )
 
 
+def run_pipeline_ab(n_rows: int = 1 << 16, d: int = 48, nnz: int = 12):
+    """Overlapped-vs-serial A/B for the staged ingest pipeline
+    (io/pipeline.py): decode → assemble → h2d on worker threads with
+    bounded queues, feeding a jitted per-chunk consumer, against the same
+    stage functions run inline. Also sweeps decode workers × queue depth so
+    the defaults come from measurement, and checks the streamed scores
+    bit-identical to the slurping reader. CPU-measurable.
+
+    On a multi-core host the overlapped pipeline must win; on a 1-core
+    host there is no parallelism to claim, so the acceptance bar is that
+    pipeline machinery costs ≤ 5% over serial (asserted below).
+    """
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.io.avro import write_avro_records
+    from photon_tpu.io.columnar import _available_cores
+    from photon_tpu.io.data_reader import FeatureShardConfig, read_merged
+    from photon_tpu.io.pipeline import stream_device_batches
+    from photon_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+    from photon_tpu.utils.timed import PipelineStats
+
+    chunk_rows = 1 << 13
+    rng = np.random.default_rng(13)
+    names = [f"f{j}" for j in range(d)]
+    _progress(f"pipeline A/B: writing deflate fixture ({n_rows} rows)")
+    records = [
+        {
+            "uid": str(i),
+            "label": float(i & 1),
+            "features": [
+                {"name": names[j], "term": "", "value": float(v)}
+                for j, v in zip(
+                    rng.choice(d, size=nnz, replace=False),
+                    rng.normal(size=nnz),
+                )
+            ],
+            "metadataMap": {"userId": f"u{i % 1024}"},
+            "weight": 1.0,
+            "offset": 0.0,
+        }
+        for i in range(n_rows)
+    ]
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "pipe.avro")
+        write_avro_records(path, TRAINING_EXAMPLE_SCHEMA, records,
+                           codec="deflate")
+        file_mb = os.path.getsize(path) / 1e6
+        cfg = {"s": FeatureShardConfig(feature_bags=["features"])}
+        _, imaps, _ = read_merged([path], cfg)  # index maps untimed
+        cores = _available_cores()
+        dim = len(imaps["s"])  # d features + injected intercept
+        w_fixed = jnp.asarray(rng.normal(size=dim).astype(np.float32) / 8.0)
+
+        # Fixed-coefficient scoring (row-independent → chunking-invariant,
+        # the bit-parity observable) plus an 8-step gradient loop for device
+        # load the host stages can overlap with.
+        @jax.jit
+        def consume(X, w):
+            scores = X @ w_fixed
+            for _ in range(8):
+                p = jax.nn.sigmoid(X @ w)
+                w = w - 1e-3 * (X.T @ p)
+            return scores, w
+
+        def run_once(overlap, workers, depth):
+            stats = PipelineStats(overlapped=overlap)
+            compute = stats.stage("compute")
+            scores, w = [], jnp.zeros(dim, jnp.float32)
+            for chunk in stream_device_batches(
+                [path], cfg, imaps, entity_id_columns={"userId": "userId"},
+                entity_indexes={}, chunk_rows=chunk_rows,
+                pad_rows_to=chunk_rows, decode_workers=workers, depth=depth,
+                overlap=overlap, telemetry_label="bench-pipeline",
+                stats=stats,
+            ):
+                t0 = time.perf_counter()
+                s, w = consume(chunk.batch.features["s"], w)
+                s_np = np.asarray(s)  # blocks → device wall on this stage
+                compute.add_busy(time.perf_counter() - t0)
+                scores.append(s_np[: chunk.n])
+            return np.concatenate(scores), stats
+
+        def timed_runs(overlap, workers, depth, reps=3):
+            run_once(overlap, workers, depth)  # warm-up: compiles + pools
+            walls, scores, stats = [], None, None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                scores, stats = run_once(overlap, workers, depth)
+                walls.append(time.perf_counter() - t0)
+            return min(walls), scores, stats
+
+        out = {
+            "metric": "ingest_pipeline_overlap_speedup",
+            "unit": "serial_wall/overlapped_wall",
+            "rows": n_rows,
+            "file_mb": round(file_mb, 1),
+            "chunk_rows": chunk_rows,
+            "host_cores": cores,
+        }
+
+        # Sweep workers × queue depth for the overlapped variant: defaults
+        # (DEFAULT_QUEUE_DEPTH, default_decode_workers) must trace to these
+        # numbers, not taste.
+        sweep = {}
+        best = None
+        for workers in sorted({1, min(4, cores), cores}):
+            for depth in (1, 2, 4):
+                _progress(
+                    f"pipeline A/B: overlapped workers={workers} depth={depth}"
+                )
+                wall, scores, stats = timed_runs(True, workers, depth)
+                sweep[f"overlapped_w{workers}_q{depth}_wall_s"] = round(wall, 4)
+                if best is None or wall < best[0]:
+                    best = (wall, workers, depth, scores, stats)
+        out.update(sweep)
+        wall_ov, best_w, best_q, scores_ov, stats_ov = best
+        out["best_workers"] = best_w
+        out["best_queue_depth"] = best_q
+
+        _progress("pipeline A/B: serial control")
+        wall_ser, scores_ser, stats_ser = timed_runs(False, 1, 1)
+        out["overlapped_wall_s"] = round(wall_ov, 4)
+        out["serial_wall_s"] = round(wall_ser, 4)
+        out["value"] = round(wall_ser / wall_ov, 4)
+        out["stages_overlapped"] = stats_ov.summary()
+        out["stages_serial"] = stats_ser.summary()
+
+        # Bit-parity: overlap vs serial vs the slurping reader.
+        batch, _, _ = read_merged(
+            [path], cfg, index_maps=imaps,
+            entity_id_columns={"userId": "userId"},
+        )
+        scores_slurp = np.asarray(batch.features["s"] @ w_fixed)
+        out["bit_identical_overlap_vs_serial"] = bool(
+            np.array_equal(scores_ov, scores_ser)
+        )
+        out["bit_identical_stream_vs_slurp"] = bool(
+            np.array_equal(scores_ov, scores_slurp)
+        )
+        assert out["bit_identical_overlap_vs_serial"], "overlap changed results"
+        assert out["bit_identical_stream_vs_slurp"], "stream != slurp"
+
+        if cores == 1:
+            # No parallelism to claim on one core: the machinery itself must
+            # be ≈free. ≤5% overhead bar per the acceptance criteria.
+            overhead = wall_ov / wall_ser - 1.0
+            out["single_core_overhead_pct"] = round(100 * overhead, 2)
+            assert overhead <= 0.05, (
+                f"pipeline overhead {100 * overhead:.1f}% > 5% on 1-core host"
+            )
+        else:
+            assert wall_ov < wall_ser, (
+                f"overlapped ({wall_ov:.3f}s) did not beat serial "
+                f"({wall_ser:.3f}s) on {cores} cores"
+            )
+    return out
+
+
 def measure_cpu_baseline():
     """Same workload on CPU: scipy L-BFGS-B fixed effect + per-entity scipy
     solves, with identical data-pass accounting."""
@@ -833,6 +995,7 @@ def run_pack(out_path: str) -> None:
     sections = [
         ("glmix_logistic_samples_per_sec_per_chip", run_glmix_bench),
         ("solve_cache_bucketed_hit_rate", run_solve_cache_ab),
+        ("ingest_pipeline_overlap_speedup", run_pipeline_ab),
         ("libsvm_logistic_sweep_samples_per_sec_per_chip", bc.run_libsvm_sweep),
         ("glmix_profile_phase_split", run_profile),
         ("sparse_wide_logistic_samples_per_sec_per_chip", bc.run_sparse_wide),
@@ -955,6 +1118,18 @@ def main():
         # Retrace/hit accounting + bucketed-vs-exact parity; CPU-measurable,
         # no backend watchdog needed (no tunnel involvement).
         print(json.dumps(run_solve_cache_ab()))
+        return
+    if "--pipeline-ab" in sys.argv:
+        # Overlapped-vs-serial ingest pipeline + workers/depth sweep +
+        # stream-vs-slurp bit parity; CPU-measurable.
+        print(json.dumps(run_pipeline_ab()))
+        return
+    if "--rmatvec-cpu-ab" in sys.argv:
+        # Four sparse-rmatvec lowerings head-to-head at CPU-mesh scale
+        # (sets data/batch.py::DEFAULT_TRANSPOSE_PLAN from the winner).
+        from bench_configs import run_rmatvec_cpu_ab
+
+        print(json.dumps(run_rmatvec_cpu_ab()))
         return
     _backend_watchdog()
     try:

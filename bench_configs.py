@@ -607,6 +607,97 @@ def measure_cpu_sparse_wide() -> float:
     return sps
 
 
+# Config 6 at CPU-mesh scale (VERDICT r5 #4): the SAME four rmatvec
+# lowerings as run_sparse_wide, shrunk so the head-to-head completes on a
+# 1-core CPU host in minutes, not hours. The winner sets
+# data/batch.py::DEFAULT_TRANSPOSE_PLAN for the current backend; the full
+# 2^20 config answers the question again on real TPU hardware.
+_RM_N, _RM_D, _RM_K = 1 << 16, 1 << 16, 32
+_RM_ITERS = 6
+
+
+def run_rmatvec_cpu_ab() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from photon_tpu.io.columnar import _available_cores
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.common import OptimizerConfig
+    from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
+
+    _progress(
+        f"rmatvec CPU A/B: generating data (2^16 × 2^16, {_RM_K} nnz/row)"
+    )
+    rng = np.random.default_rng(_SP_SEED)
+    idx = rng.integers(0, _RM_D, size=(_RM_N, _RM_K)).astype(np.int32)
+    vals = rng.normal(size=(_RM_N, _RM_K)).astype(np.float32)
+    idx[:, 0] = 0
+    vals[:, 0] = 1.0
+    w_true = (rng.normal(size=_RM_D) / 8.0).astype(np.float32)
+    z = np.sum(vals * w_true[idx], axis=1)
+    y = (rng.uniform(size=_RM_N) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0, intercept_index=0)
+    cfg = OptimizerConfig(max_iter=_RM_ITERS, track_history=False)
+    idx_dev = jnp.asarray(idx)
+    vals_f32 = jnp.asarray(vals)
+    vals_bf16 = jnp.asarray(vals.astype(ml_dtypes.bfloat16))
+    y_dev = jnp.asarray(y)
+    flat = idx.reshape(-1)
+    order = np.argsort(flat, kind="stable")
+    csc_order = jnp.asarray(order.astype(np.int32))
+    csc_segments = jnp.asarray(flat[order].astype(np.int32))
+    variants = {
+        "scatter": SparseFeatures(idx_dev, vals_f32, _RM_D),
+        "segsum": SparseFeatures(idx_dev, vals_f32, _RM_D, csc_order, csc_segments),
+        "scatter_bf16": SparseFeatures(idx_dev, vals_bf16, _RM_D),
+        "segsum_bf16": SparseFeatures(
+            idx_dev, vals_bf16, _RM_D, csc_order, csc_segments
+        ),
+    }
+
+    @jax.jit
+    def solve(w0, b):
+        res = minimize_lbfgs_margin(obj, b, w0, cfg)
+        return res.w, res.evals
+
+    walls = {}
+    best = None
+    for variant, feats in variants.items():
+        batch = LabeledBatch(y_dev, feats)
+        jax.block_until_ready(batch.features.values)
+        _progress(f"rmatvec CPU A/B: compiling + warm-up ({variant})")
+        w, ev = solve(jnp.zeros(_RM_D, jnp.float32), batch)
+        float(jnp.sum(w))
+        times = []
+        for rep in range(3):
+            t0 = time.perf_counter()
+            w, ev = solve(jnp.full((_RM_D,), 1e-6 * (rep + 1), jnp.float32), batch)
+            float(jnp.sum(w))
+            times.append(time.perf_counter() - t0)
+        walls[f"rmatvec_{variant}_wall_s"] = round(min(times), 4)
+        if best is None or min(times) < best[0]:
+            best = (min(times), variant)
+    from photon_tpu.data.batch import DEFAULT_TRANSPOSE_PLAN
+
+    return dict(
+        metric="rmatvec_cpu_ab_best_wall_s",
+        value=best[0],
+        unit="s",
+        winner=best[1],
+        n=_RM_N,
+        d=_RM_D,
+        nnz_per_row=_RM_K,
+        iters=_RM_ITERS,
+        host_cores=_available_cores(),
+        default_transpose_plan=DEFAULT_TRANSPOSE_PLAN,
+        **walls,
+    )
+
+
 # --------------------------------------------------------------------------
 # Config 5: full GAME + Bayesian auto-tune (wall-clock)
 # --------------------------------------------------------------------------

@@ -3,11 +3,25 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [unit|dryrun|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
 stage="${1:-all}"
+
+run_static() {
+    # Fast fail-first pass: import-time breakage (syntax errors, bad
+    # top-level references) surfaces in seconds instead of after the
+    # 800s pytest stage.
+    echo "== static: compileall + pyflakes =="
+    python -m compileall -q photon_tpu bench.py bench_configs.py
+    if python -c "import pyflakes" 2>/dev/null; then
+        python -m pyflakes photon_tpu bench.py bench_configs.py
+        echo "   pyflakes OK"
+    else
+        echo "   pyflakes not installed; compileall only"
+    fi
+}
 
 run_native() {
     # Source-only native dir (no committed binaries, VERDICT r3 #9): a fresh
@@ -54,11 +68,12 @@ run_install() {
 }
 
 case "$stage" in
+    static) run_static ;;
     native) run_native ;;
     unit) run_unit ;;
     dryrun) run_dryrun ;;
     install) run_install ;;
-    all) run_native; run_install; run_dryrun; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

@@ -102,6 +102,29 @@ class FixedEffectCoordinate(Coordinate):
         )
         return model, result
 
+    def train_from_stream(
+        self,
+        chunks,
+        residual_scores: Optional[Array] = None,
+        initial_model: Optional[FixedEffectModel] = None,
+    ) -> Tuple[FixedEffectModel, OptimizeResult]:
+        """Train from a pipelined chunk stream (io/pipeline.py
+        ``BatchChunk`` iterator — e.g. ``stream_device_batches`` or a
+        ``ChunkReplayCache`` replay routed through ``device_chunks_from``).
+
+        Chunks concatenate ON DEVICE as they arrive, so each chunk's
+        decode/assembly/H2D overlaps earlier chunks' placement via async
+        dispatch; the solve then runs exactly as :meth:`train` — same
+        compiled executable, same result. Feed unpadded chunks
+        (``pad_rows_to=None``): the optimizer is one whole-batch jitted
+        program, so row padding would embed inert rows in the objective.
+        """
+        from photon_tpu.io.pipeline import materialize_game_batch
+
+        return self.train(
+            materialize_game_batch(chunks), residual_scores, initial_model
+        )
+
     def score(self, model: FixedEffectModel, batch: GameBatch) -> Array:
         return model.score(batch)
 

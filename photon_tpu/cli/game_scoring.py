@@ -46,6 +46,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "widths bucket to powers of two) so the scoring "
                         "program compiles for a handful of shapes, not one "
                         "per chunk")
+    p.add_argument("--ingest-queue-depth", type=int, default=None,
+                   help="bound (in chunks) on each inter-stage pipeline "
+                        "queue (default: measured double-buffering depth, "
+                        "io/pipeline.py)")
+    p.add_argument("--serial-ingest", action="store_true",
+                   help="run the ingest stages inline on the consumer "
+                        "thread instead of on pipeline worker threads "
+                        "(the pre-pipeline behavior; the bench A/B control)")
     return p
 
 
@@ -143,23 +151,38 @@ def run(args) -> Dict:
 
     chunk_rows = int(getattr(args, "stream_ingest_chunk_rows", 0) or 0)
     if chunk_rows > 0:
-        # Streaming: feature chunks are read, scored, and dropped; only the
-        # O(n)-scalar columns (scores/labels/weights/uids/entity ids)
-        # accumulate. Chunks pad to a chunk_rows multiple so the jitted
-        # scoring program compiles for at most a couple of shapes.
+        # Streaming: decode → assemble → h2d run as pipeline stages
+        # (io/pipeline.py; worker threads + bounded queues unless
+        # --serial-ingest) overlapping the jitted scorer via async dispatch.
+        # Feature chunks are scored and dropped; only the O(n)-scalar
+        # columns (scores/labels/weights/uids/entity ids) accumulate.
+        # Chunks pad to a chunk_rows multiple so the jitted scoring program
+        # compiles for at most a couple of shapes.
+        import time
+
         from photon_tpu.data.game_data import GameBatch
-        from photon_tpu.io.data_reader import stream_merged
+        from photon_tpu.io.pipeline import (
+            DEFAULT_QUEUE_DEPTH,
+            stream_device_batches,
+        )
+        from photon_tpu.utils.timed import PipelineStats
 
         transformer = GameTransformer(model, None)
         acc: Dict[str, list] = {
             "scores": [], "label": [], "weight": [], "uid": [],
             **{rt: [] for rt in re_types},
         }
-        gen = stream_merged(
+        overlap = not getattr(args, "serial_ingest", False)
+        stats = PipelineStats(overlapped=overlap)
+        compute = stats.stage("compute")
+        gen = stream_device_batches(
             resolve_input_paths(args), shard_configs, index_maps,
-            chunk_rows=chunk_rows, **read_kwargs,
+            chunk_rows=chunk_rows, pad_rows_to=chunk_rows,
+            depth=getattr(args, "ingest_queue_depth", None)
+            or DEFAULT_QUEUE_DEPTH,
+            overlap=overlap, telemetry_label="scoring-ingest", stats=stats,
+            **read_kwargs,
         )
-        uid_base = 0
         while True:
             # Only the STREAM can be "unavailable" — scoring errors must
             # surface as themselves, not as advice to drop the flag.
@@ -172,18 +195,19 @@ def run(args) -> Dict:
                     f"streaming ingest unavailable: {exc}; drop "
                     "--stream-ingest-chunk-rows to use the slurping reader"
                 ) from exc
-            n = chunk.n
-            target = int(np.ceil(n / chunk_rows) * chunk_rows)
-            s = transformer.transform(_pad_game_batch(chunk, target))
-            acc["scores"].append(np.asarray(s)[:n])
-            acc["label"].append(np.asarray(chunk.label))
-            acc["weight"].append(np.asarray(chunk.weight))
-            # Per-chunk uids restart at 0; renumber globally so scores.avro
-            # matches the slurp path's UniqueSampleId sequence exactly.
-            acc["uid"].append(np.asarray(chunk.uid) + uid_base)
-            uid_base += n
+            n, b = chunk.n, chunk.batch
+            t0 = time.perf_counter()
+            s = transformer.transform(b)
+            scores_np = np.asarray(s)  # blocks: device compute wall
+            compute.add_busy(time.perf_counter() - t0)
+            acc["scores"].append(scores_np[:n])
+            acc["label"].append(np.asarray(b.label)[:n])
+            acc["weight"].append(np.asarray(b.weight)[:n])
+            # uids were renumbered globally by the assemble stage, so
+            # scores.avro matches the slurp path's UniqueSampleId sequence.
+            acc["uid"].append(np.asarray(b.uid)[:n])
             for rt in re_types:
-                acc[rt].append(np.asarray(chunk.entity_ids[rt]))
+                acc[rt].append(np.asarray(b.entity_ids[rt])[:n])
         if not acc["scores"]:
             raise SystemExit("streaming ingest read zero data blocks")
         scores = np.concatenate(acc["scores"])
@@ -201,6 +225,7 @@ def run(args) -> Dict:
                             for rt in re_types},
             )
             metrics = suite.evaluate_scores(jnp.asarray(scores), eval_batch)
+        pipeline_summary = stats.summary()
     else:
         batch, _, _ = read_merged(
             resolve_input_paths(args), shard_configs, index_maps=index_maps,
@@ -212,6 +237,7 @@ def run(args) -> Dict:
         weights = np.asarray(batch.weight)
         uid_arr = np.asarray(batch.uid)
         metrics = transformer.last_metrics if suite is not None else None
+        pipeline_summary = None
 
     os.makedirs(args.output_dir, exist_ok=True)
     save_scores(
@@ -223,6 +249,8 @@ def run(args) -> Dict:
         weights=weights,
     )
     out = {"numScored": int(scores.shape[0])}
+    if pipeline_summary is not None:
+        out["ingestPipeline"] = pipeline_summary
     if metrics is not None:
         out["metrics"] = metrics
         with open(os.path.join(args.output_dir, "scoring-metrics.json"), "w") as f:

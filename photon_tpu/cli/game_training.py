@@ -202,15 +202,21 @@ def run(args) -> Dict:
 
     def read(paths, index_maps, entity_indexes, intern_new):
         if chunk_rows > 0:
-            from photon_tpu.io.data_reader import concat_game_batches, stream_merged
+            # Pipelined ingest (io/pipeline.py): decode → assemble → h2d on
+            # worker threads with bounded queues, so each chunk's host work
+            # overlaps earlier chunks' device placement; unpadded chunks
+            # concatenate into one device-resident batch.
+            from photon_tpu.io.data_reader import concat_game_batches
+            from photon_tpu.io.pipeline import stream_device_batches
 
             eidx = entity_indexes if entity_indexes is not None else {}
             try:
-                chunks = list(stream_merged(
+                chunks = list(stream_device_batches(
                     paths, shard_configs, index_maps,
                     entity_id_columns=entity_id_columns, entity_indexes=eidx,
                     intern_new_entities=intern_new, chunk_rows=chunk_rows,
                     column_names=column_names,
+                    telemetry_label="game-train-ingest",
                 ))
             except (RuntimeError, ValueError) as exc:
                 # Streaming never silently slurps (the user asked for
@@ -224,7 +230,7 @@ def run(args) -> Dict:
                 raise SystemExit(
                     f"streaming ingest read zero data blocks from {paths}"
                 )
-            return concat_game_batches(chunks), index_maps, eidx
+            return concat_game_batches([c.batch for c in chunks]), index_maps, eidx
         return read_merged(
             paths, shard_configs, index_maps=index_maps,
             entity_id_columns=entity_id_columns, entity_indexes=entity_indexes,

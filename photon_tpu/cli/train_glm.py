@@ -127,6 +127,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write per-feature summary statistics as "
                         "FeatureSummarizationResultAvro "
                         "(writeBasicStatistics role)")
+    p.add_argument("--stream-ingest-chunk-rows", type=int, default=0,
+                   help="avro format: multi-pass streaming ingest "
+                        "(io/pipeline.py) — pass 1 decodes container "
+                        "blocks once (chunks of this many rows, teed into "
+                        "a byte-budgeted host replay cache) while distinct-"
+                        "scanning the feature space; pass 2 replays decoded "
+                        "chunks through assemble + host→device pipeline "
+                        "stages, concatenating on device — decode is never "
+                        "paid twice and host RAM never holds the assembled "
+                        "dataset")
+    p.add_argument("--replay-cache-mb", type=int, default=1024,
+                   help="host byte budget (MiB) for the decoded-chunk "
+                        "replay cache; when the stream outgrows it the "
+                        "cache spills and later passes re-stream from disk "
+                        "(host memory stays bounded either way)")
     add_validation_arg(p)
     p.add_argument("--verbose", action="store_true")
     return p
@@ -158,6 +173,74 @@ def _selected_features_index_map(args) -> Optional[IndexMap]:
     return IndexMap.build(sorted(keys), add_intercept=args.intercept)
 
 
+def _stream_load_avro(args, path: str, index_map: Optional[IndexMap]):
+    """Streaming multi-pass avro load (decode once, replay from a
+    byte-budgeted host cache):
+
+    pass 1  stream_avro_columnar decodes container blocks into ColumnarRows
+            chunks, teed into a ChunkReplayCache; the same pass distinct-
+            scans feature keys in global first-occurrence order — the exact
+            IndexMap the slurping reader builds (skipped when the map is
+            supplied, e.g. --selected-features-file or validation data).
+    pass 2  replays decoded chunks (re-streams from disk if the cache
+            spilled its byte budget) through the assemble + h2d pipeline
+            stages (io/pipeline.py), concatenating on device — each chunk's
+            transfer overlaps earlier chunks' placement via async dispatch,
+            and host RAM never holds the assembled dataset.
+    """
+    from photon_tpu.io.columnar import stream_avro_columnar
+    from photon_tpu.io.data_reader import _expand_paths
+    from photon_tpu.io.pipeline import (
+        ChunkReplayCache,
+        assemble_host_batches,
+        columnar_nbytes,
+        device_chunks_from,
+        materialize_game_batch,
+    )
+
+    chunk_rows = args.stream_ingest_chunk_rows
+    paths = _expand_paths([path])
+    cache = ChunkReplayCache(
+        lambda: stream_avro_columnar(paths, chunk_rows),
+        byte_budget=args.replay_cache_mb << 20,
+        nbytes=columnar_nbytes,
+    )
+    imap = index_map
+    if imap is None:
+        seen: Dict[str, None] = {}
+        for cols in cache:
+            ids = [
+                cols.bags[b].key_ids
+                for b in ("features",)
+                if b in cols.bags and cols.bags[b].key_ids.size
+            ]
+            if ids:
+                for i in np.unique(np.concatenate(ids)):
+                    seen.setdefault(cols.intern[i], None)
+        imap = IndexMap.build(seen, add_intercept=args.intercept)
+    cfg = {
+        "features": FeatureShardConfig(
+            feature_bags=["features"], has_intercept=args.intercept
+        )
+    }
+    batch = materialize_game_batch(
+        device_chunks_from(
+            lambda: assemble_host_batches(
+                iter(cache), cfg, {"features": imap}
+            ),
+            telemetry_label="train-ingest",
+        )
+    )
+    log = logging.getLogger("photon_tpu.train_glm")
+    log.info(
+        "streaming ingest: decode passes=%d replay passes=%d cache=%s",
+        cache.source_passes, cache.replay_passes,
+        "spilled" if cache.spilled
+        else f"{cache.cached_bytes >> 20} MiB held",
+    )
+    return batch.labeled_batch("features"), imap
+
+
 def _load(args, path: Optional[str], index_map=None):
     if path is None:
         return None, index_map
@@ -170,6 +253,8 @@ def _load(args, path: Optional[str], index_map=None):
             add_intercept=args.intercept,
         )
         return LabeledBatch(jnp.asarray(y), jnp.asarray(X)), imap
+    if int(getattr(args, "stream_ingest_chunk_rows", 0) or 0) > 0:
+        return _stream_load_avro(args, path, index_map)
     cfg = {"features": FeatureShardConfig(feature_bags=["features"], has_intercept=args.intercept)}
     batch, imaps, _ = read_merged(
         [path], cfg, index_maps=None if index_map is None else {"features": index_map}

@@ -23,6 +23,16 @@ import numpy as np
 
 Array = jax.Array
 
+# Measured default for the padded-sparse rmatvec lowering at the ingest
+# boundary (FeatureShardConfig.transpose_plan=None resolves to this).
+# Head-to-head on this image's CPU mesh (bench.py --rmatvec-cpu-ab,
+# BENCH_FULL.md): the duplicate-index scatter-add beat the column-sorted
+# segment_sum, so no transpose plan is attached by default. XLA:TPU
+# serializes colliding scatter updates, so re-run the A/B (and
+# run_sparse_wide at full scale) on real hardware before trusting this
+# default there.
+DEFAULT_TRANSPOSE_PLAN = False
+
 
 @jax.tree_util.register_pytree_node_class
 class SparseFeatures:
@@ -80,13 +90,17 @@ class SparseFeatures:
     def with_transpose_plan(self) -> "SparseFeatures":
         """Return a copy carrying the column-sorted transpose plan (one host
         argsort over the static index pattern; ~2 extra int32 nnz-sized
-        arrays in device memory)."""
+        arrays in device memory). Host (numpy) matrices get a host plan —
+        the pipeline's h2d stage places all leaves together."""
         flat = np.asarray(self.indices).reshape(-1)
         order = np.argsort(flat, kind="stable")
+        as_arr = (
+            np.asarray if isinstance(self.indices, np.ndarray) else jnp.asarray
+        )
         return SparseFeatures(
             self.indices, self.values, self.dim,
-            csc_order=jnp.asarray(order.astype(np.int32)),
-            csc_segments=jnp.asarray(flat[order].astype(np.int32)),
+            csc_order=as_arr(order.astype(np.int32)),
+            csc_segments=as_arr(flat[order].astype(np.int32)),
         )
 
     def to_dense(self) -> Array:

@@ -26,8 +26,17 @@ class GameTransformer:
         self.evaluation_suite = evaluation_suite
         # Model passed as an argument so repeated transforms (same batch
         # shapes) reuse one compiled program instead of retracing against a
-        # fresh model-closure every call.
-        self._score = jax.jit(lambda model, batch: model.score_with_offset(batch))
+        # fresh model-closure every call. trace_count increments inside the
+        # traced body, so it counts REAL XLA traces (the retrace-contract
+        # observable for streamed scoring: at most one per bucket shape),
+        # not Python calls — the solve_cache.py counter pattern.
+        self.trace_count = 0
+
+        def _score(model, batch):
+            self.trace_count += 1
+            return model.score_with_offset(batch)
+
+        self._score = jax.jit(_score)
 
     def transform(self, batch: GameBatch) -> Array:
         """Per-sample total scores (model + offsets), jitted."""

@@ -420,16 +420,68 @@ def read_avro_columnar(paths: Sequence[str]) -> Optional[ColumnarRows]:
             g.close()
 
 
+def _cgroup_quota_cores() -> Optional[int]:
+    """Cores granted by the cgroup CPU controller, or None when unlimited.
+
+    sched_getaffinity over-reports in quota-limited containers (a pod
+    pinned to 2 CPUs of quota still sees every host core in its mask), so
+    the decode pool would oversubscribe and thrash. v2 reads
+    ``cpu.max`` ("<quota> <period>" or "max ..."); v1 reads
+    ``cpu.cfs_quota_us`` / ``cpu.cfs_period_us`` (-1 = unlimited).
+    Fractional quotas round UP: 1.5 CPUs of quota decodes with 2 workers.
+    """
+    for quota_path, period_path in (
+        ("/sys/fs/cgroup/cpu.max", None),  # v2: one file, "quota period"
+        (
+            "/sys/fs/cgroup/cpu/cpu.cfs_quota_us",  # v1 pair
+            "/sys/fs/cgroup/cpu/cpu.cfs_period_us",
+        ),
+    ):
+        try:
+            with open(quota_path) as f:
+                first = f.read().split()
+            if period_path is None:
+                quota_s, period_s = first[0], first[1]
+            else:
+                quota_s = first[0]
+                with open(period_path) as f:
+                    period_s = f.read().split()[0]
+            if quota_s in ("max", "-1"):
+                return None
+            quota, period = int(quota_s), int(period_s)
+            if quota <= 0 or period <= 0:
+                return None
+            return max(1, -(-quota // period))  # ceil division
+        except (OSError, ValueError, IndexError):
+            continue
+    return None
+
+
 def _available_cores() -> int:
-    """Cores available to THIS process (cgroup/affinity-aware where the
-    platform supports it; sched_getaffinity is Linux-only)."""
+    """Cores available to THIS process: PHOTON_TPU_DECODE_WORKERS env
+    override first, else min(affinity mask, cgroup CPU quota) — the quota
+    bound because sched_getaffinity over-reports in quota-limited
+    containers (sched_getaffinity is Linux-only; cpu_count is the
+    portable fallback)."""
+    env = os.environ.get("PHOTON_TPU_DECODE_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass  # malformed override: fall through to detection
+    cores = None
     getaff = getattr(os, "sched_getaffinity", None)
     if getaff is not None:
         try:
-            return max(1, len(getaff(0)))
+            cores = max(1, len(getaff(0)))
         except OSError:  # pragma: no cover - exotic platforms
             pass
-    return max(1, os.cpu_count() or 1)
+    if cores is None:
+        cores = max(1, os.cpu_count() or 1)
+    quota = _cgroup_quota_cores()
+    if quota is not None:
+        cores = min(cores, quota)
+    return max(1, cores)
 
 
 def merge_columnar(parts: Sequence[ColumnarRows]) -> ColumnarRows:

@@ -56,6 +56,20 @@ class FeatureShardConfig:
     has_intercept: bool = True
     # Densify when the shard dimension is at most this; padded-sparse above.
     dense_dim_limit: int = 4096
+    # rmatvec lowering for padded-sparse shards: True attaches the
+    # column-sorted transpose plan (segment_sum), False keeps the
+    # duplicate-index scatter-add, None takes the measured backend default
+    # (data/batch.py::DEFAULT_TRANSPOSE_PLAN, set by bench.py
+    # --rmatvec-cpu-ab / run_sparse_wide head-to-heads).
+    transpose_plan: Optional[bool] = None
+
+    @property
+    def resolved_transpose_plan(self) -> bool:
+        from photon_tpu.data.batch import DEFAULT_TRANSPOSE_PLAN
+
+        if self.transpose_plan is None:
+            return DEFAULT_TRANSPOSE_PLAN
+        return bool(self.transpose_plan)
 
 
 def _feature_key(f: dict) -> str:
@@ -179,7 +193,10 @@ def rows_to_game_batch(
                 X[i, ix] = vs
             features[shard] = jnp.asarray(X)
         else:
-            features[shard] = SparseFeatures.from_rows(sparse_rows, d)
+            sf = SparseFeatures.from_rows(sparse_rows, d)
+            if cfg.resolved_transpose_plan:
+                sf = sf.with_transpose_plan()
+            features[shard] = sf
 
     entity_ids: Dict[str, np.ndarray] = {}
     for re_type, col in entity_id_columns.items():
@@ -234,13 +251,21 @@ def _columnar_to_game_batch(
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
     intern_new_entities: bool = True,
     column_names: Optional[InputColumnsNames] = None,
+    to_device: bool = True,
 ) -> Tuple[GameBatch, Dict[str, EntityIndex]]:
     """Vectorized rows_to_game_batch over native-decoded columns: one
-    IndexMap lookup per DISTINCT key, numpy scatters for the matrices."""
+    IndexMap lookup per DISTINCT key, numpy scatters for the matrices.
+
+    ``to_device=False`` keeps every leaf numpy (GameBatch is leaf-agnostic):
+    the pipeline's assemble stage runs concurrently with device compute, so
+    placement is deferred to its h2d stage (io/pipeline.py) — implicit
+    jnp.asarray here would serialize transfers into assembly.
+    """
     n = cols.n
     entity_id_columns = entity_id_columns or {}
     entity_indexes = entity_indexes if entity_indexes is not None else {}
     cn = column_names or InputColumnsNames()
+    as_arr = jnp.asarray if to_device else np.asarray
 
     def _num_col(names):
         for name in names:
@@ -305,7 +330,7 @@ def _columnar_to_game_batch(
             # matching the row path's overwrite semantics
             if icpt >= 0:
                 X[:, icpt] = 1.0
-            features[shard] = jnp.asarray(X)
+            features[shard] = as_arr(X)
         else:
             # Padded-sparse, built without any per-row Python loop.
             counts = np.bincount(rows_all, minlength=n).astype(np.int64)
@@ -325,9 +350,10 @@ def _columnar_to_game_batch(
                 slot = counts - 1
                 indices[np.arange(n), slot] = icpt
                 values[np.arange(n), slot] = 1.0
-            features[shard] = SparseFeatures(
-                jnp.asarray(indices), jnp.asarray(values), d
-            )
+            sf = SparseFeatures(as_arr(indices), as_arr(values), d)
+            if cfg.resolved_transpose_plan:
+                sf = sf.with_transpose_plan()
+            features[shard] = sf
 
     entity_ids: Dict[str, np.ndarray] = {}
     for re_type, col in entity_id_columns.items():
@@ -374,12 +400,12 @@ def _columnar_to_game_batch(
         entity_ids[re_type] = ids
 
     batch = GameBatch(
-        label=jnp.asarray(label),
-        offset=jnp.asarray(offset),
-        weight=jnp.asarray(weight),
+        label=as_arr(label),
+        offset=as_arr(offset),
+        weight=as_arr(weight),
         features=features,
-        entity_ids={k: jnp.asarray(v) for k, v in entity_ids.items()},
-        uid=jnp.asarray(np.arange(n, dtype=np.int64)),
+        entity_ids={k: as_arr(v) for k, v in entity_ids.items()},
+        uid=as_arr(np.arange(n, dtype=np.int64)),
     )
     return batch, entity_indexes
 
@@ -490,12 +516,16 @@ def concat_game_batches(batches: List[GameBatch]) -> GameBatch:
                     p.dim,
                 )
 
+            had_plan = all(p.csc_order is not None for p in parts)
             parts = [pad(p) for p in parts]
-            features[shard] = SparseFeatures(
+            sf = SparseFeatures(
                 jnp.concatenate([p.indices for p in parts]),
                 jnp.concatenate([p.values for p in parts]),
                 dim,
             )
+            if had_plan:  # one host argsort over the concatenated pattern
+                sf = sf.with_transpose_plan()
+            features[shard] = sf
         else:
             features[shard] = jnp.concatenate(parts)
     entity_ids = {

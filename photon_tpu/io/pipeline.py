@@ -1,0 +1,565 @@
+"""Pipelined ingest→device data path: overlap decode, assembly, and H2D
+transfer with device compute.
+
+SURVEY §7 hard part 4 ("keep the mesh fed"): the streaming reader
+(io/columnar.py::stream_avro_columnar) already decodes container blocks
+concurrently, but the per-chunk tail — GameBatch assembly (IndexMap lookups,
+CSR scatters) and host→device placement — ran strictly serially with device
+compute: the device idled during host work and the host idled during device
+work. This module runs the three host stages on worker threads with bounded
+queues (backpressure), so a jitted consumer overlaps all of them via JAX's
+async dispatch — the Snap-ML-style hierarchical pipelining of data loading
+against compute (PAPERS.md), host-side counterpart of PR 1's compile-once
+device hot loop.
+
+Stages (each its own thread when ``overlap=True``):
+
+    decode    stream_avro_columnar: container blocks → ColumnarRows chunks
+              (itself block-parallel; the stage thread additionally moves the
+              file-order merge off the consumer)
+    assemble  ColumnarRows → HOST GameBatch (numpy: vectorized IndexMap
+              lookups + CSR scatters; cumulative entity interning keeps this
+              stage strictly in chunk order)
+    h2d       bucket-pad (numpy, so the jitted consumer never retraces after
+              warmup) → jax.device_put
+
+Backpressure: every inter-stage queue is bounded at ``depth`` chunks, so host
+memory holds at most ``3·depth + in-flight`` chunks regardless of file size.
+Telemetry: per-stage busy/starved/backpressured wall, items, bytes, and
+queue-depth samples land in utils/timed.py ``PipelineStats`` — surfaced by
+driver summaries and ``bench.py --pipeline-ab``.
+
+``overlap=False`` runs the identical stage functions inline (the serial
+per-chunk path the drivers used before this module) — the A/B control, and
+the zero-thread-overhead path for 1-core hosts. Outputs are bit-identical
+either way: threads change WHEN work happens, never what it computes.
+
+Defaults (``DEFAULT_QUEUE_DEPTH``, ``default_decode_workers``) come from the
+measured ``bench.py --pipeline-ab`` sweep on the bench host, not taste — see
+BENCH_FULL.md's stage-timing section.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.utils.timed import PipelineStats, StageStats, record_pipeline
+
+# Queue bound between stages, in chunks. Measured on the bench host
+# (bench.py --pipeline-ab sweeps {1, 2, 4}): depth 2 is double-buffering —
+# one chunk in flight downstream, one buffered — and deeper queues bought
+# nothing while holding more chunk memory. See BENCH_FULL.md.
+DEFAULT_QUEUE_DEPTH = 2
+
+_DONE = object()
+
+
+def default_decode_workers() -> int:
+    """Decode-stage block parallelism: one worker per available core
+    (affinity/cgroup-quota aware, PHOTON_TPU_DECODE_WORKERS overrides —
+    io/columnar.py::_available_cores), capped like stream_avro_columnar."""
+    from photon_tpu.io.columnar import _available_cores
+
+    return min(16, _available_cores())
+
+
+@dataclasses.dataclass
+class BatchChunk:
+    """One pipeline chunk: ``batch`` is numpy-leaved after assemble, device-
+    resident after h2d. ``n`` is the valid row count (pre-padding); ``uid``
+    inside the batch is already renumbered globally."""
+
+    batch: object  # GameBatch
+    n: int
+    index: int
+
+
+def chunk_nbytes(chunk: BatchChunk) -> int:
+    """Host bytes of a chunk's arrays (replay-cache budget accounting)."""
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree_util.tree_leaves(chunk.batch)
+    )
+
+
+def columnar_nbytes(cols) -> int:
+    total = 0
+    for group in (cols.numeric, cols.longs, cols.strings):
+        total += sum(a.nbytes for a in group.values())
+    for b in cols.bags.values():
+        total += b.offsets.nbytes + b.key_ids.nbytes + b.values.nbytes
+    total += cols.meta_rows.nbytes + cols.meta_keys.nbytes + cols.meta_vals.nbytes
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Thread plumbing: bounded queues + stop event + error forwarding.
+# ---------------------------------------------------------------------------
+
+
+class _Failure:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _put(q: "queue.Queue", item, stop: threading.Event) -> bool:
+    """Put respecting shutdown; returns False when the pipeline stopped."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _get(q: "queue.Queue", stop: threading.Event):
+    """Get respecting shutdown; returns _DONE when the pipeline stopped."""
+    while not stop.is_set():
+        try:
+            return q.get(timeout=0.05)
+        except queue.Empty:
+            continue
+    return _DONE
+
+
+def _source_thread(
+    make_iter: Callable[[], Iterator],
+    out_q: "queue.Queue",
+    stage: StageStats,
+    stop: threading.Event,
+    nbytes_of: Callable,
+) -> None:
+    gen = None
+    try:
+        gen = make_iter()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(gen)
+            except StopIteration:
+                break
+            stage.add_busy(time.perf_counter() - t0, nbytes_of(item))
+            t1 = time.perf_counter()
+            if not _put(out_q, item, stop):
+                return
+            stage.add_wait_out(time.perf_counter() - t1)
+            stage.sample_depth(out_q.qsize())
+        _put(out_q, _DONE, stop)
+    except BaseException as exc:  # noqa: BLE001 — forwarded to the consumer
+        _put(out_q, _Failure(exc), stop)
+    finally:
+        if gen is not None:
+            gen.close()  # shuts the decode block pool on abandonment
+
+
+def _stage_thread(
+    fn: Callable,
+    in_q: "queue.Queue",
+    out_q: "queue.Queue",
+    stage: StageStats,
+    stop: threading.Event,
+    nbytes_of: Callable,
+) -> None:
+    try:
+        while True:
+            t0 = time.perf_counter()
+            item = _get(in_q, stop)
+            stage.add_wait_in(time.perf_counter() - t0)
+            if item is _DONE:
+                _put(out_q, _DONE, stop)
+                return
+            if isinstance(item, _Failure):
+                _put(out_q, item, stop)
+                return
+            t1 = time.perf_counter()
+            out = fn(item)
+            stage.add_busy(time.perf_counter() - t1, nbytes_of(out))
+            t2 = time.perf_counter()
+            if not _put(out_q, out, stop):
+                return
+            stage.add_wait_out(time.perf_counter() - t2)
+            stage.sample_depth(out_q.qsize())
+    except BaseException as exc:  # noqa: BLE001 — forwarded to the consumer
+        _put(out_q, _Failure(exc), stop)
+
+
+def _run_staged(
+    make_source: Callable[[], Iterator],
+    source_nbytes: Callable,
+    stages: List,  # [(name, fn, nbytes_of)]
+    stats: PipelineStats,
+    depth: int,
+    overlap: bool,
+    source_name: str = "decode",
+) -> Iterator:
+    """Compose source + transform stages into one output iterator, threaded
+    (bounded queues) or inline — same functions, same order, same results."""
+    if not overlap:
+        src_stage = stats.stage(source_name)
+        stage_objs = [(stats.stage(name), fn, nb) for name, fn, nb in stages]
+        gen = make_source()
+        try:
+            for item in gen:
+                src_stage.add_busy(0.0, source_nbytes(item))
+                # busy time for the source is folded into the consumer's
+                # iteration in serial mode; per-stage transform walls are
+                # still measured so the A/B can compare stage costs.
+                for stage, fn, nb in stage_objs:
+                    t0 = time.perf_counter()
+                    item = fn(item)
+                    stage.add_busy(time.perf_counter() - t0, nb(item))
+                yield item
+        finally:
+            gen.close()
+        return
+
+    stop = threading.Event()
+    queues = [queue.Queue(maxsize=depth) for _ in range(len(stages) + 1)]
+    threads = [
+        threading.Thread(
+            target=_source_thread,
+            args=(make_source, queues[0], stats.stage(source_name), stop, source_nbytes),
+            name=f"photon-pipe-{source_name}",
+            daemon=True,
+        )
+    ]
+    for i, (name, fn, nbytes_of) in enumerate(stages):
+        threads.append(
+            threading.Thread(
+                target=_stage_thread,
+                args=(fn, queues[i], queues[i + 1], stats.stage(name), stop, nbytes_of),
+                name=f"photon-pipe-{name}",
+                daemon=True,
+            )
+        )
+    for t in threads:
+        t.start()
+    out_q = queues[-1]
+    try:
+        while True:
+            item = _get(out_q, stop)
+            if item is _DONE:
+                return
+            if isinstance(item, _Failure):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# Concrete stages: decode → assemble → h2d over GameBatch chunks.
+# ---------------------------------------------------------------------------
+
+
+def _bucket_pad_host(chunk: BatchChunk, pad_rows_to: int) -> BatchChunk:
+    """Numpy twin of the scoring driver's device-side padding: rows pad to
+    the next ``pad_rows_to`` multiple with weight-0 samples and -1 entity
+    ids; padded-sparse nnz widths bucket to the next power of two. Applied
+    to EVERY chunk (a chunk landing exactly on the multiple still buckets
+    its nnz width) so the jitted consumer compiles once per bucket shape."""
+    from photon_tpu.data.batch import SparseFeatures
+    from photon_tpu.data.game_data import GameBatch
+
+    b = chunk.batch
+    n = chunk.n
+    target = int(np.ceil(n / pad_rows_to) * pad_rows_to) if n else pad_rows_to
+    pad = target - n
+
+    def pad_feat(v):
+        if isinstance(v, SparseFeatures):
+            k = v.indices.shape[1]
+            k_pad = 1 << max(0, (k - 1)).bit_length()
+            if pad == 0 and k_pad == k:
+                return v
+            indices = np.pad(np.asarray(v.indices), ((0, pad), (0, k_pad - k)))
+            values = np.pad(np.asarray(v.values), ((0, pad), (0, k_pad - k)))
+            out = SparseFeatures(indices, values, v.dim)
+            if v.csc_order is not None:  # padding changed the index pattern
+                out = out.with_transpose_plan()
+            return out
+        return v if pad == 0 else np.pad(v, ((0, pad), (0, 0)))
+
+    if pad == 0:
+        features = {k: pad_feat(v) for k, v in b.features.items()}
+        if all(f is v for f, v in zip(features.values(), b.features.values())):
+            return chunk
+        return BatchChunk(
+            dataclasses.replace(b, features=features), n, chunk.index
+        )
+    padf = lambda a: np.pad(a, (0, pad))  # noqa: E731
+    batch = GameBatch(
+        label=padf(b.label),
+        offset=padf(b.offset),
+        weight=padf(b.weight),  # zeros: padding rows carry no weight
+        features={k: pad_feat(v) for k, v in b.features.items()},
+        entity_ids={
+            k: np.pad(v, (0, pad), constant_values=-1)
+            for k, v in b.entity_ids.items()
+        },
+        uid=None if b.uid is None else padf(b.uid),
+    )
+    return BatchChunk(batch, n, chunk.index)
+
+
+def _h2d(chunk: BatchChunk, pad_rows_to: Optional[int]) -> BatchChunk:
+    import jax
+
+    if pad_rows_to:
+        chunk = _bucket_pad_host(chunk, pad_rows_to)
+    return BatchChunk(jax.device_put(chunk.batch), chunk.n, chunk.index)
+
+
+def _make_assembler(
+    shard_configs,
+    index_maps,
+    entity_id_columns,
+    entity_indexes,
+    intern_new_entities,
+    column_names,
+):
+    """ColumnarRows → host BatchChunk closure. Stateful: entity interning is
+    cumulative and uids renumber globally, so exactly ONE assembler consumes
+    the chunk stream, in order."""
+    from photon_tpu.io.data_reader import _columnar_to_game_batch
+
+    state = {"uid_base": 0, "index": 0, "eidx": entity_indexes}
+
+    def assemble(cols) -> BatchChunk:
+        batch, state["eidx"] = _columnar_to_game_batch(
+            cols,
+            shard_configs,
+            index_maps,
+            entity_id_columns,
+            state["eidx"],
+            intern_new_entities,
+            column_names,
+            to_device=False,
+        )
+        batch = dataclasses.replace(
+            batch,
+            uid=np.arange(state["uid_base"], state["uid_base"] + cols.n, dtype=np.int64),
+        )
+        out = BatchChunk(batch, cols.n, state["index"])
+        state["uid_base"] += cols.n
+        state["index"] += 1
+        return out
+
+    return assemble
+
+
+def assemble_host_batches(
+    cols_iter: Iterator,
+    shard_configs: Dict,
+    index_maps: Dict,
+    entity_id_columns: Optional[Dict[str, str]] = None,
+    entity_indexes: Optional[Dict] = None,
+    intern_new_entities: bool = True,
+    column_names=None,
+) -> Iterator[BatchChunk]:
+    """Assemble an existing ColumnarRows iterator (e.g. a ChunkReplayCache
+    replay of decoded chunks) into host (numpy) GameBatch chunks with
+    globally-renumbered uids. Strictly in-order, single consumer (entity
+    interning is cumulative)."""
+    assemble = _make_assembler(
+        shard_configs, index_maps, entity_id_columns,
+        entity_indexes if entity_indexes is not None else {},
+        intern_new_entities, column_names,
+    )
+    for cols in cols_iter:
+        yield assemble(cols)
+
+
+def stream_host_batches(
+    paths: Sequence[str],
+    shard_configs: Dict,
+    index_maps: Dict,
+    entity_id_columns: Optional[Dict[str, str]] = None,
+    entity_indexes: Optional[Dict] = None,
+    intern_new_entities: bool = True,
+    chunk_rows: int = 1 << 16,
+    column_names=None,
+    decode_workers: Optional[int] = None,
+) -> Iterator[BatchChunk]:
+    """Decode + assemble inline (no threads): host (numpy) GameBatch chunks
+    with globally-renumbered uids — the replay-cache fill path and the
+    serial control's host half."""
+    from photon_tpu.io.columnar import stream_avro_columnar
+    from photon_tpu.io.data_reader import _expand_paths
+
+    yield from assemble_host_batches(
+        stream_avro_columnar(_expand_paths(paths), chunk_rows, workers=decode_workers),
+        shard_configs, index_maps, entity_id_columns, entity_indexes,
+        intern_new_entities, column_names,
+    )
+
+
+def stream_device_batches(
+    paths: Sequence[str],
+    shard_configs: Dict,
+    index_maps: Dict,
+    entity_id_columns: Optional[Dict[str, str]] = None,
+    entity_indexes: Optional[Dict] = None,
+    intern_new_entities: bool = True,
+    chunk_rows: int = 1 << 16,
+    column_names=None,
+    decode_workers: Optional[int] = None,
+    depth: int = DEFAULT_QUEUE_DEPTH,
+    pad_rows_to: Optional[int] = None,
+    overlap: bool = True,
+    telemetry_label: str = "ingest",
+    stats: Optional[PipelineStats] = None,
+) -> Iterator[BatchChunk]:
+    """The full pipeline: decode → assemble → h2d, yielding device-resident
+    GameBatch chunks the consumer's jitted compute overlaps with.
+
+    ``pad_rows_to`` pads every chunk to a row-count multiple (weight-0 rows,
+    -1 entity ids) and buckets sparse nnz widths to powers of two — the
+    retrace-free scoring contract. Leave None for exact-shape chunks (e.g.
+    when chunks will be concatenated into one batch).
+
+    ``overlap=False`` is the serial per-chunk control: identical stage
+    functions run inline on the consumer thread — bit-identical chunks,
+    no threads. Telemetry lands in utils/timed.py under
+    ``telemetry_label`` either way.
+    """
+    from photon_tpu.io.columnar import stream_avro_columnar
+    from photon_tpu.io.data_reader import _expand_paths
+
+    if stats is None:
+        stats = PipelineStats(overlapped=overlap)
+    else:
+        stats.overlapped = overlap
+    record_pipeline(telemetry_label, stats)
+    expanded = _expand_paths(paths)
+    assemble = _make_assembler(
+        shard_configs, index_maps, entity_id_columns,
+        entity_indexes if entity_indexes is not None else {},
+        intern_new_entities, column_names,
+    )
+
+    def source():
+        return stream_avro_columnar(expanded, chunk_rows, workers=decode_workers)
+
+    stages = [
+        ("assemble", assemble, chunk_nbytes),
+        ("h2d", lambda c: _h2d(c, pad_rows_to), lambda c: 0),
+    ]
+    t0 = time.perf_counter()
+    try:
+        yield from _run_staged(
+            source, columnar_nbytes, stages, stats, depth, overlap
+        )
+    finally:
+        stats.wall_s = time.perf_counter() - t0
+        stats.log(telemetry_label)
+
+
+def device_chunks_from(
+    host_chunks: Callable[[], Iterator[BatchChunk]],
+    depth: int = DEFAULT_QUEUE_DEPTH,
+    pad_rows_to: Optional[int] = None,
+    overlap: bool = True,
+    telemetry_label: str = "replay",
+    stats: Optional[PipelineStats] = None,
+) -> Iterator[BatchChunk]:
+    """Run only the h2d stage over an existing host-chunk source (a replay
+    cache pass): placement overlaps compute, decode/assembly already paid."""
+    if stats is None:
+        stats = PipelineStats(overlapped=overlap)
+    else:
+        stats.overlapped = overlap
+    record_pipeline(telemetry_label, stats)
+    stages = [("h2d", lambda c: _h2d(c, pad_rows_to), lambda c: 0)]
+    t0 = time.perf_counter()
+    try:
+        yield from _run_staged(
+            host_chunks, chunk_nbytes, stages, stats, depth, overlap,
+            source_name="assemble",
+        )
+    finally:
+        stats.wall_s = time.perf_counter() - t0
+        stats.log(telemetry_label)
+
+
+def materialize_game_batch(chunks: Iterator[BatchChunk]):
+    """Concatenate device chunks (use pad_rows_to=None sources) into one
+    GameBatch: each chunk's H2D overlaps the previous chunks' device concat
+    via async dispatch — the pipelined replacement for slurp-then-put."""
+    from photon_tpu.io.data_reader import concat_game_batches
+
+    batches = [c.batch for c in chunks]
+    if not batches:
+        raise ValueError("streaming ingest read zero data blocks")
+    return concat_game_batches(batches)
+
+
+class ChunkReplayCache:
+    """Host-side chunk cache for multi-pass streaming training: decode once,
+    replay many.
+
+    Pass 1 pulls from ``source_factory()`` (typically
+    :func:`stream_host_batches` — decode + assembly) and tees each chunk
+    into memory while the running total stays within ``byte_budget``. Later
+    passes replay from memory — decode and assembly are never paid again.
+    If the stream outgrows the budget, the cache SPILLS: it drops what it
+    held and every pass (including the current one) streams from the
+    source, so host memory stays bounded by the budget plus one in-flight
+    chunk either way.
+
+    Single-consumer: passes must not interleave. A pass abandoned mid-way
+    leaves the cache incomplete and the next pass re-streams.
+    """
+
+    def __init__(
+        self,
+        source_factory: Callable[[], Iterator[BatchChunk]],
+        byte_budget: int = 1 << 30,
+        nbytes: Callable = chunk_nbytes,
+    ):
+        self._factory = source_factory
+        self.byte_budget = int(byte_budget)
+        self._nbytes = nbytes
+        self._chunks: List[BatchChunk] = []
+        self._complete = False
+        self.spilled = False
+        self.cached_bytes = 0
+        self.source_passes = 0
+        self.replay_passes = 0
+
+    def __iter__(self) -> Iterator[BatchChunk]:
+        if self._complete:
+            self.replay_passes += 1
+            yield from self._chunks
+            return
+        self.source_passes += 1
+        self._chunks, self.cached_bytes = [], 0
+        caching = not self.spilled
+        finished = False
+        try:
+            for chunk in self._factory():
+                if caching:
+                    self.cached_bytes += self._nbytes(chunk)
+                    if self.cached_bytes > self.byte_budget:
+                        self.spilled, caching = True, False
+                        self._chunks, self.cached_bytes = [], 0
+                    else:
+                        self._chunks.append(chunk)
+                yield chunk
+            finished = True
+        finally:
+            if finished and caching:
+                self._complete = True
+            elif not finished:
+                self._chunks, self.cached_bytes = [], 0

@@ -1,0 +1,334 @@
+"""Pipelined ingest→device data path (io/pipeline.py): overlap parity,
+error propagation, thread shutdown, replay-cache semantics, and the
+retrace contract for streamed scoring.
+
+The pipeline's core promise is that threads change WHEN work happens but
+never WHAT it computes — every test here pins one face of that promise.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.io.avro import write_avro_records
+from photon_tpu.io.columnar import _load_lib
+from photon_tpu.io.data_reader import FeatureShardConfig, read_merged
+from photon_tpu.io.pipeline import (
+    BatchChunk,
+    ChunkReplayCache,
+    assemble_host_batches,
+    device_chunks_from,
+    materialize_game_batch,
+    stream_device_batches,
+    stream_host_batches,
+)
+from photon_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+from photon_tpu.estimators.game_transformer import GameTransformer
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.game import FixedEffectModel, GameModel
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+rng = np.random.default_rng(7)
+
+native_available = pytest.mark.skipif(
+    _load_lib() is None, reason="no C++ toolchain for the native decoder"
+)
+
+CFG = {"s": FeatureShardConfig(feature_bags=["features"])}
+IDS = {"userId": "userId"}
+
+
+def _write(path, n=1000, d=12, block_rows=50):
+    records = []
+    for i in range(n):
+        nnz = int(rng.integers(1, d))
+        idx = rng.choice(d, size=nnz, replace=False)
+        records.append({
+            "uid": str(i),
+            "label": float(i % 2),
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(rng.normal())}
+                for j in idx
+            ],
+            "metadataMap": {"userId": f"u{i % 17}"},
+            "weight": 1.0 + (i % 3),
+            "offset": 0.25 * (i % 4),
+        })
+    write_avro_records(str(path), TRAINING_EXAMPLE_SCHEMA, records,
+                       block_records=block_rows)
+
+
+def _assert_chunks_identical(a: BatchChunk, b: BatchChunk):
+    assert a.n == b.n and a.index == b.index
+    np.testing.assert_array_equal(np.asarray(a.batch.label), np.asarray(b.batch.label))
+    np.testing.assert_array_equal(np.asarray(a.batch.weight), np.asarray(b.batch.weight))
+    np.testing.assert_array_equal(np.asarray(a.batch.offset), np.asarray(b.batch.offset))
+    np.testing.assert_array_equal(np.asarray(a.batch.uid), np.asarray(b.batch.uid))
+    for k in a.batch.features:
+        np.testing.assert_array_equal(
+            np.asarray(a.batch.features[k]), np.asarray(b.batch.features[k])
+        )
+    for k in a.batch.entity_ids:
+        np.testing.assert_array_equal(
+            np.asarray(a.batch.entity_ids[k]), np.asarray(b.batch.entity_ids[k])
+        )
+
+
+def _no_pipe_threads(deadline_s=5.0):
+    """True once no photon-pipe-* thread remains alive (bounded poll: the
+    consumer joins with a timeout, so threads may take a beat to exit)."""
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        if not [t for t in threading.enumerate()
+                if t.name.startswith("photon-pipe-") and t.is_alive()]:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@native_available
+@pytest.mark.parametrize("pad_rows_to", [None, 256])
+def test_overlap_bit_identical_to_serial(tmp_path, pad_rows_to):
+    """overlap=True must yield chunks BIT-IDENTICAL to overlap=False —
+    same boundaries, same global uid renumbering, same cumulative entity
+    interning, with and without bucket padding."""
+    path = tmp_path / "p.avro"
+    _write(path, n=1000)
+    _, imaps, _ = read_merged([str(path)], CFG, entity_id_columns=IDS)
+
+    def run(overlap):
+        eidx = {}
+        chunks = list(stream_device_batches(
+            [str(path)], CFG, imaps, entity_id_columns=IDS,
+            entity_indexes=eidx, chunk_rows=256, pad_rows_to=pad_rows_to,
+            overlap=overlap, telemetry_label=f"test-overlap-{overlap}",
+        ))
+        return chunks, eidx
+
+    threaded, eidx_t = run(True)
+    serial, eidx_s = run(False)
+    assert len(threaded) == len(serial) >= 3
+    for a, b in zip(threaded, serial):
+        _assert_chunks_identical(a, b)
+    assert eidx_t["userId"].ids() == eidx_s["userId"].ids()
+    assert _no_pipe_threads()
+
+
+@native_available
+def test_pipeline_error_reaches_consumer_and_threads_exit(tmp_path):
+    """A decode failure on a worker thread must surface as a Python
+    exception in the CONSUMER, and every pipeline thread must exit — no
+    orphaned stage threads spinning after a failed ingest."""
+    path = tmp_path / "bad.avro"
+    _write(path, n=500)
+    raw = path.read_bytes()
+    path.write_bytes(raw[: len(raw) - 40])  # truncate inside the last block
+    # Streaming needs prebuilt index maps; build them from a clean copy.
+    good = tmp_path / "good.avro"
+    _write(good, n=500)
+    _, imaps, _ = read_merged([str(good)], CFG)
+    with pytest.raises(Exception):
+        list(stream_device_batches(
+            [str(path)], CFG, imaps, chunk_rows=64, overlap=True,
+            telemetry_label="test-error",
+        ))
+    assert _no_pipe_threads()
+
+
+@native_available
+def test_abandoned_pipeline_shuts_down_threads(tmp_path):
+    """Dropping the generator after one chunk must stop and join all
+    photon-pipe-* threads (backpressure means they'd otherwise block on
+    full queues forever)."""
+    path = tmp_path / "a.avro"
+    _write(path, n=2000)
+    _, imaps, _ = read_merged([str(path)], CFG)
+    gen = stream_device_batches(
+        [str(path)], CFG, imaps, chunk_rows=64, depth=1, overlap=True,
+        telemetry_label="test-abandon",
+    )
+    first = next(gen)
+    assert first.n > 0
+    gen.close()
+    assert _no_pipe_threads()
+
+
+@native_available
+def test_materialize_matches_slurp(tmp_path):
+    """Chunked decode → assemble → h2d → device concat must reproduce the
+    slurp path's GameBatch exactly (the streaming-training data path)."""
+    path = tmp_path / "m.avro"
+    _write(path, n=700)
+    full, imaps, _ = read_merged([str(path)], CFG, entity_id_columns=IDS)
+    merged = materialize_game_batch(stream_device_batches(
+        [str(path)], CFG, imaps, entity_id_columns=IDS, chunk_rows=128,
+        telemetry_label="test-materialize",
+    ))
+    assert merged.n == full.n
+    np.testing.assert_array_equal(np.asarray(merged.label), np.asarray(full.label))
+    np.testing.assert_array_equal(
+        np.asarray(merged.features["s"]), np.asarray(full.features["s"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(merged.entity_ids["userId"]), np.asarray(full.entity_ids["userId"])
+    )
+    np.testing.assert_array_equal(np.asarray(merged.uid), np.asarray(full.uid))
+
+
+def test_materialize_empty_stream_raises():
+    with pytest.raises(ValueError, match="zero data blocks"):
+        materialize_game_batch(iter(()))
+
+
+# ---------------------------------------------------------------------------
+# ChunkReplayCache
+# ---------------------------------------------------------------------------
+
+
+def _fake_chunks(k=5, rows=10):
+    return [
+        BatchChunk(np.full((rows,), i, dtype=np.float64), rows, i)
+        for i in range(k)
+    ]
+
+
+def test_replay_cache_replays_without_second_decode():
+    pulls = {"n": 0}
+    chunks = _fake_chunks()
+
+    def factory():
+        pulls["n"] += 1
+        yield from chunks
+
+    cache = ChunkReplayCache(factory, byte_budget=1 << 20)
+    first = list(cache)
+    second = list(cache)
+    assert pulls["n"] == 1  # decode paid exactly once
+    assert cache.source_passes == 1 and cache.replay_passes == 1
+    assert not cache.spilled
+    assert [c.index for c in first] == [c.index for c in second] == list(range(5))
+    for a, b in zip(first, second):
+        assert a is b  # replay yields the SAME host chunks, no copies
+
+
+def test_replay_cache_spills_over_budget_and_restreams():
+    chunks = _fake_chunks(k=4, rows=100)  # 800 B per chunk
+    pulls = {"n": 0}
+
+    def factory():
+        pulls["n"] += 1
+        yield from chunks
+
+    cache = ChunkReplayCache(factory, byte_budget=1000)  # fits 1, spills on 2nd
+    assert len(list(cache)) == 4  # spill must not drop output chunks
+    assert cache.spilled and cache.cached_bytes == 0
+    assert len(list(cache)) == 4
+    assert pulls["n"] == 2  # over budget → every pass re-streams
+    assert cache.replay_passes == 0
+
+
+def test_replay_cache_abandoned_pass_restreams():
+    pulls = {"n": 0}
+
+    def factory():
+        pulls["n"] += 1
+        yield from _fake_chunks()
+
+    cache = ChunkReplayCache(factory, byte_budget=1 << 20)
+    it = iter(cache)
+    next(it)
+    it.close()  # abandoned mid-pass: cache is incomplete
+    assert not cache.spilled and cache.cached_bytes == 0
+    assert len(list(cache)) == 5  # next pass re-streams and completes
+    assert pulls["n"] == 2
+    assert len(list(cache)) == 5 and pulls["n"] == 2  # now replays
+
+
+@native_available
+def test_replay_then_assemble_matches_direct_stream(tmp_path):
+    """Decode-once training path: cache decoded columnar chunks, then
+    assemble+h2d from the replay — result identical to streaming the file
+    end-to-end twice."""
+    from photon_tpu.io.columnar import stream_avro_columnar
+    from photon_tpu.io.pipeline import columnar_nbytes
+
+    path = tmp_path / "r.avro"
+    _write(path, n=600)
+    _, imaps, _ = read_merged([str(path)], CFG)
+    cache = ChunkReplayCache(
+        lambda: stream_avro_columnar([str(path)], chunk_rows=128),
+        byte_budget=1 << 26, nbytes=columnar_nbytes,
+    )
+    out = []
+    for _pass in range(2):
+        merged = materialize_game_batch(device_chunks_from(
+            lambda: assemble_host_batches(iter(cache), CFG, imaps),
+            telemetry_label="test-replay",
+        ))
+        out.append(merged)
+    assert cache.source_passes == 1 and cache.replay_passes == 1
+    direct = materialize_game_batch(
+        device_chunks_from(
+            lambda: stream_host_batches([str(path)], CFG, imaps, chunk_rows=128),
+            telemetry_label="test-direct",
+        )
+    )
+    for merged in out:
+        np.testing.assert_array_equal(
+            np.asarray(merged.features["s"]), np.asarray(direct.features["s"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(merged.label), np.asarray(direct.label)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Retrace contract: streamed scoring compiles once per bucket shape.
+# ---------------------------------------------------------------------------
+
+
+@native_available
+def test_streamed_scoring_traces_once_per_bucket_shape(tmp_path):
+    """Scoring ≥3 streamed chunks (incl. a ragged tail) with bucket padding
+    must compile the jitted scorer at most once per padded shape — NOT once
+    per chunk. trace_count increments inside the traced body (PR-1 counter
+    pattern), so it counts real XLA traces."""
+    path = tmp_path / "t.avro"
+    _write(path, n=1000, block_rows=50)  # chunks of 300,300,300 + ragged 100
+    full, imaps, _ = read_merged([str(path)], CFG)
+    dim = len(imaps["s"])
+    w = jnp.asarray(rng.normal(size=(dim,)).astype(np.float32))
+    model = GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(w), TaskType.LINEAR_REGRESSION),
+            "s",
+        )
+    })
+
+    transformer = GameTransformer(model)
+    chunks = list(stream_device_batches(
+        [str(path)], CFG, imaps, chunk_rows=256, pad_rows_to=256,
+        telemetry_label="test-retrace",
+    ))
+    assert len(chunks) >= 3
+    assert chunks[-1].n < 256  # ragged tail really happened
+    scores = []
+    shapes = set()
+    for c in chunks:
+        out = np.asarray(transformer.transform(c.batch))
+        scores.append(out[: c.n])
+        shapes.add(tuple(np.asarray(c.batch.label).shape))
+    assert len(shapes) < len(chunks)  # padding actually bucketed shapes
+    assert transformer.trace_count <= len(shapes)
+
+    # Padding rows (weight 0, uid pad) must not perturb the valid rows.
+    reference = GameTransformer(model)
+    np.testing.assert_allclose(
+        np.concatenate(scores),
+        np.asarray(reference.transform(full)),
+        rtol=1e-5, atol=1e-5,
+    )

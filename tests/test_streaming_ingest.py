@@ -27,7 +27,7 @@ native_available = pytest.mark.skipif(
 )
 
 
-def _write(path, n=1000, d=12, block_rows=97):
+def _write(path, n=1000, d=12, block_rows=97, codec="deflate"):
     records = []
     for i in range(n):
         nnz = int(rng.integers(1, d))
@@ -44,7 +44,7 @@ def _write(path, n=1000, d=12, block_rows=97):
             "offset": 0.25 * (i % 4),
         })
     write_avro_records(str(path), TRAINING_EXAMPLE_SCHEMA, records,
-                       block_records=block_rows)
+                       codec=codec, block_records=block_rows)
     return records
 
 
@@ -155,13 +155,16 @@ def test_corrupt_container_never_crashes_the_process(tmp_path):
 
 
 @native_available
+@pytest.mark.parametrize("codec", ["null", "deflate"])
 @pytest.mark.parametrize("chunk_rows", [64, 300, 10_000])
-def test_parallel_stream_bit_identical_to_serial(tmp_path, chunk_rows):
+def test_parallel_stream_bit_identical_to_serial(tmp_path, chunk_rows, codec):
     """workers>1 decodes blocks concurrently but must produce chunks
     BIT-IDENTICAL to the serial path: same boundaries, same intern order,
-    same CSR layout (the merge preserves file order)."""
+    same CSR layout (the merge preserves file order). Both codecs, because
+    null blocks skip the zlib path entirely and exercise different buffer
+    handoffs in the native decoder."""
     path = tmp_path / "par.avro"
-    _write(path, n=1200, block_rows=53)
+    _write(path, n=1200, block_rows=53, codec=codec)
     serial = list(stream_avro_columnar([str(path)], chunk_rows=chunk_rows, workers=1))
     parallel = list(stream_avro_columnar([str(path)], chunk_rows=chunk_rows, workers=4))
     assert len(serial) == len(parallel)
@@ -181,6 +184,40 @@ def test_parallel_stream_bit_identical_to_serial(tmp_path, chunk_rows):
         np.testing.assert_array_equal(s.meta_rows, p.meta_rows)
         np.testing.assert_array_equal(s.meta_keys, p.meta_keys)
         np.testing.assert_array_equal(s.meta_vals, p.meta_vals)
+
+
+@native_available
+def test_abandoned_stream_shuts_down_decode_pool(tmp_path, monkeypatch):
+    """Abandoning the generator mid-stream (gen.close()) must shut the
+    decode pool down promptly: queued read-ahead futures cancelled, worker
+    threads joined — no leak of the ~2*workers in-flight blocks."""
+    import concurrent.futures as cf
+
+    shutdowns = []
+
+    class SpyPool(cf.ThreadPoolExecutor):
+        def shutdown(self, wait=True, *, cancel_futures=False):
+            shutdowns.append({"wait": wait, "cancel_futures": cancel_futures})
+            super().shutdown(wait=wait, cancel_futures=cancel_futures)
+
+    # stream_avro_columnar imports ThreadPoolExecutor from concurrent.futures
+    # at call time, so patching the module attribute intercepts its pool.
+    monkeypatch.setattr(cf, "ThreadPoolExecutor", SpyPool)
+
+    path = tmp_path / "abandon.avro"
+    _write(path, n=2000, block_rows=20)  # 100 blocks: plenty of read-ahead
+    gen = stream_avro_columnar([str(path)], chunk_rows=40, workers=4)
+    first = next(gen)
+    assert first.n > 0
+    assert shutdowns == []  # pool alive while the stream is live
+    gen.close()
+    assert shutdowns == [{"wait": True, "cancel_futures": True}]
+    # The pool's worker threads must actually be gone, not just signalled.
+    decode_threads = [
+        t for t in __import__("threading").enumerate()
+        if t.name.startswith("SpyPool") or "ThreadPoolExecutor" in t.name
+    ]
+    assert not any(t.is_alive() for t in decode_threads)
 
 
 @native_available
