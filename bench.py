@@ -953,7 +953,7 @@ def _error_line(
     return _artifact_line(metric, kind, msg, pack_path)
 
 
-def run_pack(out_path: str) -> None:
+def run_pack(out_path: str, telemetry_out: str = None) -> None:
     """The full TPU evidence pack in ONE process (the axon tunnel is a
     scarce, breakable resource — one session captures everything). Each
     section's JSON line is appended to ``out_path`` AND printed as soon as
@@ -1042,7 +1042,12 @@ def run_pack(out_path: str) -> None:
         timer.start()
         try:
             try:
-                r = fn()
+                from photon_tpu.obs.trace import span as _span
+
+                # Each section lands as one trace span, so --telemetry-out
+                # maps the pack's JSON lines onto host-wall attribution.
+                with _span(f"bench/{metric}"):
+                    r = fn()
             except Exception as exc:  # noqa: BLE001 — keep capturing evidence
                 r = _error_line(metric, exc, pack_path=out_path)
             with io_lock:
@@ -1055,6 +1060,10 @@ def run_pack(out_path: str) -> None:
             timer.cancel()
         if r.get("metric") != "glmix_profile_phase_split" or "error" in r:
             print(json.dumps(r), flush=True)
+    if telemetry_out:
+        from photon_tpu.obs import finalize_run_report
+
+        finalize_run_report("bench", path=telemetry_out)
 
 
 def _backend_watchdog(seconds: int = 240) -> None:
@@ -1100,6 +1109,14 @@ def main():
 
         measure_all_cpu_baselines()
         return
+    telemetry_out = None
+    if "--telemetry-out" in sys.argv:
+        try:
+            telemetry_out = sys.argv[sys.argv.index("--telemetry-out") + 1]
+        except IndexError:
+            print("usage: bench.py ... --telemetry-out <run.jsonl>",
+                  file=sys.stderr)
+            sys.exit(2)
     if "--pack" in sys.argv:
         try:
             out_path = sys.argv[sys.argv.index("--pack") + 1]
@@ -1112,7 +1129,7 @@ def main():
             print(f"cannot write pack output {out_path}: {exc}", file=sys.stderr)
             sys.exit(2)
         _backend_watchdog()
-        run_pack(out_path)
+        run_pack(out_path, telemetry_out=telemetry_out)
         return
     if "--solve-cache-ab" in sys.argv:
         # Retrace/hit accounting + bucketed-vs-exact parity; CPU-measurable,
@@ -1147,6 +1164,10 @@ def main():
         results.extend(run_extra_configs())
     for r in results:
         print(json.dumps(r))
+    if telemetry_out:
+        from photon_tpu.obs import finalize_run_report
+
+        finalize_run_report("bench", path=telemetry_out)
 
 
 if __name__ == "__main__":

@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -46,6 +46,66 @@ run_dryrun() {
     python __graft_entry__.py
 }
 
+run_telemetry() {
+    # End-to-end smoke of the unified run report: train a tiny GLM with
+    # --telemetry-out and assert the JSONL parses, carries at least one span
+    # per CD iteration (the λ sweep), the solve-cache counters, and no
+    # NaN/Inf anywhere in the artifact.
+    echo "== telemetry: train_glm --telemetry-out smoke =="
+    tmp="$(mktemp -d)"
+    python - "$tmp" <<'EOF'
+import sys, os, json, collections
+import numpy as np
+
+tmp = sys.argv[1]
+rng = np.random.default_rng(3)
+lines = []
+for _ in range(200):
+    x = rng.normal(size=5)
+    y = 1 if rng.uniform() < 1 / (1 + np.exp(-(x[0] - x[1]))) else -1
+    feats = " ".join(f"{j + 1}:{x[j]:.4f}" for j in range(5))
+    lines.append(f"{y:+d} {feats}")
+data = os.path.join(tmp, "train.txt")
+with open(data, "w") as f:
+    f.write("\n".join(lines))
+
+from photon_tpu.cli import train_glm
+
+tele = os.path.join(tmp, "run.jsonl")
+args = train_glm.build_parser().parse_args([
+    "--training-data", data, "--format", "libsvm",
+    "--output-dir", os.path.join(tmp, "out"),
+    "--regularization-weights", "0.1,1",
+    "--max-iterations", "10",
+    "--telemetry-out", tele,
+])
+train_glm.run(args)
+
+text = open(tele).read()
+assert "NaN" not in text and "Infinity" not in text, "non-finite leaked"
+from photon_tpu.obs import validate_record
+records = [json.loads(line) for line in text.splitlines()]
+for rec in records:
+    validate_record(rec)
+kinds = collections.Counter(r["record"] for r in records)
+assert kinds["meta"] == 1 and kinds["env"] == 1, kinds
+cd_rows = [r for r in records if r["record"] == "coordinate_descent"]
+spans = [r for r in records if r["record"] == "span"]
+solve_spans = [s for s in spans if s["name"].startswith("glm/lambda")
+               and s["name"].endswith("/solve")]
+assert len(cd_rows) == 2, cd_rows
+# ≥1 span per CD iteration (train_glm's λ sweep is its coordinate sequence)
+assert len(solve_spans) >= len(cd_rows), (solve_spans, cd_rows)
+cache = {r["metric"]: r["value"] for r in records
+         if r["record"] == "metric" and r["metric"].startswith("solve_cache_")}
+assert cache.get("solve_cache_calls") == 2, cache
+assert "solve_cache_hits" in cache and "solve_cache_traces" in cache, cache
+print(f"   {len(records)} records, {len(spans)} spans, "
+      f"solve_cache={ {k: v for k, v in sorted(cache.items())} } OK")
+EOF
+    rm -rf "$tmp"
+}
+
 run_install() {
     echo "== packaging: editable install + console entry points =="
     tmp="$(mktemp -d)"
@@ -72,8 +132,9 @@ case "$stage" in
     native) run_native ;;
     unit) run_unit ;;
     dryrun) run_dryrun ;;
+    telemetry) run_telemetry ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

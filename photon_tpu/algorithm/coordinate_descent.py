@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from photon_tpu.algorithm.coordinate import Coordinate
 from photon_tpu.data.game_data import GameBatch
 from photon_tpu.models.game import GameModel
+from photon_tpu.obs.metrics import registry
+from photon_tpu.obs.trace import span
 
 Array = jax.Array
 logger = logging.getLogger(__name__)
@@ -219,31 +221,44 @@ class CoordinateDescent:
                 # Residual: all OTHER coordinates' scores
                 # (summedScores − thisCoordinateScores, reference :441-446).
                 residual = None if single else total_scores - scores[cid]
-                model, diag = coord.train(batch, residual, models[cid])
-                new_scores = coord.score(model, batch)
-                if profile:
-                    # The clock must cover device execution, not dispatch.
-                    jax.block_until_ready(new_scores)
+                with span(f"cd/iter{it}/{cid}"):
+                    with span("solve"):
+                        model, diag = coord.train(batch, residual, models[cid])
+                    with span("score"):
+                        new_scores = coord.score(model, batch)
+                        if profile:
+                            # The clock must cover device execution, not
+                            # dispatch.
+                            jax.block_until_ready(new_scores)
                 wall = time.monotonic() - t0
                 total_scores = total_scores - scores[cid] + new_scores
                 scores[cid] = new_scores
                 models[cid] = model
                 tracker[cid].append(diag)
                 wall_times[cid].append(wall)
+                registry().counter(
+                    "cd_coordinate_updates_total", coordinate=cid
+                ).inc()
                 logger.info(
                     "CD iter %d coordinate %s trained in %.2fs", it, cid, wall
                 )
                 if emitter is not None:
                     from photon_tpu.utils.events import optimization_log_event
 
+                    # diag.summary() reads device-resident history arrays —
+                    # a host sync. Under profile=False the dispatch loop must
+                    # stay sync-free, so the event carries the summary only
+                    # when profiling; the run report reads the same
+                    # diagnostics once at finalize either way.
                     emitter.emit(
                         optimization_log_event(
                             coordinate=cid,
                             cd_iteration=it,
                             wall_s=wall,
                             summary=(
-                                diag.summary() if hasattr(diag, "summary")
-                                else repr(diag)
+                                diag.summary()
+                                if profile and hasattr(diag, "summary")
+                                else None
                             ),
                         )
                     )
@@ -257,6 +272,8 @@ class CoordinateDescent:
                     best_metric = primary
                     best_model = game_model
                 logger.info("CD iter %d validation: %s", it, metrics)
+
+            registry().counter("cd_iterations_total").inc()
 
             if checkpoint_dir is not None and (it + 1) % checkpoint_every == 0:
                 from photon_tpu.utils.checkpoint import save_checkpoint

@@ -30,6 +30,7 @@ from photon_tpu.ops.variance import coefficient_variances, normalize_variance_ty
 from photon_tpu.optim.common import OptimizeResult
 from photon_tpu.optim.factory import OptimizerSpec
 from photon_tpu.algorithm.solve_cache import SolveCache, default_cache
+from photon_tpu.obs.trace import span
 from photon_tpu.sampling.down_sampler import DownSampler
 from photon_tpu.types import TaskType, VarianceComputationType
 
@@ -84,7 +85,10 @@ class FixedEffectCoordinate(Coordinate):
         if folded:
             w0 = norm.model_to_transformed_space(w0)
         solve = self.solve_cache.fe_solver(self.objective, self.optimizer_spec)
-        result = solve(w0, lb)
+        # Host-wall span of the dispatch (the solve itself runs async on
+        # device; nothing here blocks).
+        with span("fe_solve"):
+            result = solve(w0, lb)
         # SIMPLE/FULL variance computation
         # (DistributedOptimizationProblem.scala:83-103 role). Evaluated at
         # the transformed-space optimum (self-consistent with the folded

@@ -35,6 +35,7 @@ from photon_tpu.models.game import (
     ProjectedRandomEffectModel,
     RandomEffectModel,
 )
+from photon_tpu.obs.trace import span
 from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.ops.variance import coefficient_variances, normalize_variance_type
 from photon_tpu.optim.common import (
@@ -117,6 +118,18 @@ class RandomEffectTrackerStats:
             f"entities={self.num_entities} converged={self.num_converged} "
             f"hit_max_iter={self.num_max_iter} iters(mean={self.mean_iterations:.1f}, "
             f"max={self.max_iterations})"
+        )
+
+    def diagnostics_dict(self) -> dict:
+        """Report-ready aggregates. Materializes the device-resident rows —
+        call only at run-report finalize, never inside the dispatch loop."""
+        return dict(
+            type="random_effect",
+            entities=self.num_entities,
+            converged=self.num_converged,
+            hit_max_iter=self.num_max_iter,
+            mean_iterations=self.mean_iterations,
+            max_iterations=self.max_iterations,
         )
 
 
@@ -384,15 +397,16 @@ class RandomEffectCoordinate(Coordinate):
         # consecutive blocks pipeline on device instead of serializing
         # through the host.
         results = []
-        for i, block in enumerate(self.dataset.blocks):
-            offs = block.gather_offsets(total_offset)
-            w0 = self._dense_warm_start(coefs, block, d)
-            mask = self._feature_masks.get(i)
-            solver = self.solve_cache.block_solver(
-                self._block_objectives[i], self.optimizer_spec, self._config,
-                has_mask=mask is not None,
-            )
-            results.append((block, *solver(block, offs, w0, mask)))
+        with span("re_dispatch_blocks"):
+            for i, block in enumerate(self.dataset.blocks):
+                offs = block.gather_offsets(total_offset)
+                w0 = self._dense_warm_start(coefs, block, d)
+                mask = self._feature_masks.get(i)
+                solver = self.solve_cache.block_solver(
+                    self._block_objectives[i], self.optimizer_spec, self._config,
+                    has_mask=mask is not None,
+                )
+                results.append((block, *solver(block, offs, w0, mask)))
 
         # One scatter for the whole pass: per-block outputs (sliced back to
         # the dataset width) concatenate and write once; shape-bucket
@@ -441,19 +455,20 @@ class RandomEffectCoordinate(Coordinate):
         block_coefs, block_vars, col_maps, block_offs = [], [], [], []
         # Sync-free dispatch: every block solve is issued before any
         # dependent work (variances) touches the outputs.
-        for i, block in enumerate(self.dataset.blocks):
-            offs = block.gather_offsets(total_offset)
-            w0 = self._initial_block_coefs(block, i, initial_model)
-            obj = self._block_objectives[i]
-            mask = self._feature_masks.get(i)
-            solver = self.solve_cache.block_solver(
-                obj, self.optimizer_spec, self._config, has_mask=mask is not None
-            )
-            w_new, iters, reasons = solver(block, offs, w0, mask)
-            block_coefs.append(w_new)
-            col_maps.append(block.col_map)
-            block_offs.append(offs)
-            parts.append((block.entity_idx, iters, reasons))
+        with span("re_dispatch_blocks"):
+            for i, block in enumerate(self.dataset.blocks):
+                offs = block.gather_offsets(total_offset)
+                w0 = self._initial_block_coefs(block, i, initial_model)
+                obj = self._block_objectives[i]
+                mask = self._feature_masks.get(i)
+                solver = self.solve_cache.block_solver(
+                    obj, self.optimizer_spec, self._config, has_mask=mask is not None
+                )
+                w_new, iters, reasons = solver(block, offs, w0, mask)
+                block_coefs.append(w_new)
+                col_maps.append(block.col_map)
+                block_offs.append(offs)
+                parts.append((block.entity_idx, iters, reasons))
         if self.compute_variance != VarianceComputationType.NONE:
             for i, block in enumerate(self.dataset.blocks):
                 obj = self._block_objectives[i]

@@ -272,6 +272,20 @@ class SolveCache:
             self._pins.clear()
             self.stats = SolveCacheStats()
 
+    def reset_stats(self) -> None:
+        """Zero the counters while KEEPING compiled executables.
+
+        Mutates in place: already-built traced closures captured this stats
+        object, so replacing it would route their retrace increments to a
+        dead object. Used by ``obs.begin_run`` so a run report counts this
+        run's dispatches, not the process's lifetime."""
+        with self._lock:
+            s = self.stats
+            s.traces = 0
+            s.calls = 0
+            s.hits = 0
+            s.trace_keys.clear()
+
 
 _default_cache = SolveCache()
 

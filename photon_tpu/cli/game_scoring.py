@@ -54,6 +54,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the ingest stages inline on the consumer "
                         "thread instead of on pipeline worker threads "
                         "(the pre-pipeline behavior; the bench A/B control)")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   help="dotted paths of event listener callables")
+    p.add_argument("--event-listener", action="append", default=[],
+                   dest="event_listener",
+                   help="register one event listener by path "
+                        "('pkg.module:attr'); repeatable")
+    p.add_argument("--telemetry-out", default=None,
+                   help="write the unified run report (spans + metrics + "
+                        "ingest-pipeline occupancy) as schema-stable JSONL "
+                        "to this path")
     return p
 
 
@@ -104,6 +114,22 @@ def _pad_game_batch(b, target_n: int):
 
 def run(args) -> Dict:
     setup_logging(args.verbose)
+    from photon_tpu.obs import begin_run, finalize_run_report
+    from photon_tpu.utils.events import (
+        EventEmitter,
+        setup_event,
+        training_finish_event,
+    )
+
+    begin_run()  # fresh spans / metrics / phase records for THIS run
+    emitter = EventEmitter()
+    for name in list(getattr(args, "event_listeners", [])) + list(
+        getattr(args, "event_listener", [])
+    ):
+        emitter.register_by_name(name)
+    emitter.emit(
+        setup_event(driver="game_scoring", model_input_dir=args.model_input_dir)
+    )
     shard_configs: Dict = {}
     for spec in args.feature_shard_configurations:
         shard_configs.update(parse_feature_shard_config(spec))
@@ -255,6 +281,10 @@ def run(args) -> Dict:
         out["metrics"] = metrics
         with open(os.path.join(args.output_dir, "scoring-metrics.json"), "w") as f:
             json.dump(metrics, f, indent=2)
+    emitter.emit(training_finish_event(num_scored=out["numScored"]))
+    finalize_run_report(
+        "game_scoring", path=args.telemetry_out, emitter=emitter
+    )
     return out
 
 

@@ -129,6 +129,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="dotted paths of event listener callables "
                         "(Driver.scala:99-108 registration role)")
+    p.add_argument("--event-listener", action="append", default=[],
+                   dest="event_listener",
+                   help="register one event listener by path "
+                        "('pkg.module:attr'); repeatable")
+    p.add_argument("--telemetry-out", default=None,
+                   help="write the unified run report (spans + metrics + "
+                        "coordinate-descent diagnostics) as schema-stable "
+                        "JSONL to this path")
     p.add_argument("--summarization-output-dir", default=None,
                    help="write per-feature summary statistics as "
                         "FeatureSummarizationResultAvro, one file per shard "
@@ -149,7 +157,24 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(args) -> Dict:
     setup_logging(args.verbose)
+    from photon_tpu.obs import begin_run, finalize_run_report
+
+    begin_run()  # fresh spans / metrics / phase records for THIS run
     task = task_of(args)
+    from photon_tpu.utils.events import EventEmitter, setup_event
+
+    emitter = EventEmitter()
+    for name in list(args.event_listeners) + list(
+        getattr(args, "event_listener", [])
+    ):
+        emitter.register_by_name(name)
+    emitter.emit(
+        setup_event(
+            driver="game_training",
+            task=args.task,
+            update_sequence=args.update_sequence,
+        )
+    )
 
     shard_configs: Dict = {}
     for spec in args.feature_shard_configurations:
@@ -334,15 +359,8 @@ def run(args) -> Dict:
         ignore_threshold_for_new_models=args.ignore_threshold_for_new_models,
         warm_start_model=warm,
     )
-    from photon_tpu.utils.events import (
-        EventEmitter,
-        training_finish_event,
-        training_start_event,
-    )
+    from photon_tpu.utils.events import training_finish_event, training_start_event
 
-    emitter = EventEmitter()
-    for name in args.event_listeners:
-        emitter.register_by_name(name)
     emitter.emit(
         training_start_event(
             task=task.value, coordinates=list(update_sequence)
@@ -418,6 +436,22 @@ def run(args) -> Dict:
         json.dump(sanitize_for_json(summary), f, indent=2)
     emitter.emit(
         training_finish_event(best=None if best is None else best.config.describe())
+    )
+    finalize_run_report(
+        "game_training",
+        path=args.telemetry_out,
+        emitter=emitter,
+        trackers=[
+            {
+                "label": f"{key}[{i}]",
+                "tracker": r.tracker,
+                "wall_times": r.wall_times,
+            }
+            for key, pool in (
+                ("config", results), ("tuned", tuned_results)
+            )
+            for i, r in enumerate(pool)
+        ],
     )
     return summary
 

@@ -15,6 +15,7 @@ import enum
 import json
 import logging
 import os
+import time
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
@@ -45,6 +46,7 @@ from photon_tpu.types import NormalizationType, OptimizerType, TaskType
 from photon_tpu.utils.events import (
     EventEmitter,
     optimization_log_event,
+    setup_event,
     training_finish_event,
     training_start_event,
 )
@@ -123,6 +125,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="dotted paths of event listener callables")
+    p.add_argument("--event-listener", action="append", default=[],
+                   dest="event_listener",
+                   help="register one event listener by path "
+                        "('pkg.module:attr'); repeatable")
+    p.add_argument("--telemetry-out", default=None,
+                   help="write the unified run report (spans + metrics + "
+                        "per-lambda solver diagnostics) as schema-stable "
+                        "JSONL to this path")
     p.add_argument("--summarization-output-dir", default=None,
                    help="write per-feature summary statistics as "
                         "FeatureSummarizationResultAvro "
@@ -264,11 +274,18 @@ def _load(args, path: Optional[str], index_map=None):
 
 def run(args) -> Dict:
     setup_logging(args.verbose)
+    from photon_tpu.obs import begin_run, finalize_run_report, span
+
+    begin_run()  # fresh spans / metrics / phase records for THIS run
     task = task_of(args)
     stage = DriverStage.INIT
     emitter = EventEmitter()
-    for name in args.event_listeners:
+    for name in list(args.event_listeners) + list(
+        getattr(args, "event_listener", [])
+    ):
         emitter.register_by_name(name)
+    emitter.emit(setup_event(driver="train_glm", task=args.task,
+                             optimizer=args.optimizer))
 
     if args.validate_per_iteration and args.validation_data is None:
         raise ValueError(
@@ -337,7 +354,11 @@ def run(args) -> Dict:
     loss = loss_for_task(task)
     emitter.emit(training_start_event(task=task.value, weights=weights))
 
+    from photon_tpu.algorithm.solve_cache import default_cache
+
     models: List[Dict] = []
+    solver_diags: List = []
+    solver_walls: List[float] = []
     w = jnp.zeros((train.dim,), jnp.float32)
     for lam in weights:
         objective = GLMObjective(
@@ -351,9 +372,17 @@ def run(args) -> Dict:
             OptimizerType[args.optimizer], args.max_iterations, args.tolerance,
             box=box, track_history=args.optimization_state_tracker,
         )
-        solve = make_optimizer(objective, spec)
+        # λ solves route through the shared compiled-solver cache — same
+        # semantics as make_optimizer, but retraces and hits are accounted
+        # (and a repeated λ config reuses one executable).
+        solve = default_cache().fe_solver(objective, spec)
         w0_lam = w
-        result = solve(w, train)
+        t0 = time.monotonic()
+        with span(f"glm/lambda{lam:g}"):
+            with span("solve"):
+                result = solve(w, train)
+        solver_walls.append(time.monotonic() - t0)
+        solver_diags.append(result)
         w = result.w  # warm start (ModelTraining.scala:162-200)
         w_model = norm.transformed_to_model_space(w) if norm is not None else w
         from photon_tpu.ops.variance import (
@@ -478,6 +507,18 @@ def run(args) -> Dict:
         # the bare token Infinity is not RFC-8259 JSON.
         json.dump(sanitize_for_json(summary), f, indent=2)
     emitter.emit(training_finish_event(best_lambda=best["lambda"]))
+    finalize_run_report(
+        "train_glm",
+        path=args.telemetry_out,
+        emitter=emitter,
+        trackers=[{
+            "label": "glm",
+            # One tracker row per λ solve (the driver's CD-analogue: the
+            # λ sweep IS its coordinate sequence).
+            "tracker": {"global": solver_diags},
+            "wall_times": {"global": solver_walls},
+        }],
+    )
     return summary
 
 

@@ -59,6 +59,22 @@ def bucket_dim(x: int) -> int:
     return p
 
 
+def _publish_pad_waste(re_type: str, **dims: Tuple[int, int]) -> None:
+    """Shape-bucket pad-waste telemetry, one (used, allocated) pair per dim
+    (entities / samples / features). Published at dataset build — a one-time
+    host-side step — so reading it never touches the solve hot path."""
+    from photon_tpu.obs.metrics import registry
+
+    reg = registry()
+    for dim, (used, alloc) in dims.items():
+        kw = dict(re_type=str(re_type), dim=dim)
+        reg.counter("bucket_alloc_total", **kw).inc(int(alloc))
+        reg.counter("bucket_used_total", **kw).inc(int(used))
+        reg.histogram("bucket_pad_waste_ratio", **kw).observe(
+            1.0 - (used / alloc) if alloc else 0.0
+        )
+
+
 def _byteswap64(x: np.ndarray) -> np.ndarray:
     """Deterministic sampling key (role of Spark's byteswap64 hash,
     RandomEffectDataset.scala:517-524)."""
@@ -324,11 +340,19 @@ def build_random_effect_dataset(
         # (weight 0, train_mask False, entity_idx −1). Projected blocks keep
         # their exact content-defined col_map width.
         E_alloc = E
+        n_used = int(counts[sel].sum())
+        d_used = d_block
         if config.shape_bucketing:
             n_max = bucket_dim(n_max)
             E_alloc = bucket_dim(E)
             if not project:
                 d_block = bucket_dim(d_block)
+        _publish_pad_waste(
+            config.re_type,
+            entities=(E, E_alloc),
+            samples=(n_used, E_alloc * n_max),
+            features=(d_used, d_block),
+        )
 
         feat = np.zeros((E_alloc, n_max, d_block), dtype=feat_dtype)
         lab = np.zeros((E_alloc, n_max), dtype=label.dtype)

@@ -88,6 +88,10 @@ class GameResult:
     config: GameOptimizationConfig
     metrics: Optional[Dict[str, float]]
     tracker: Dict[str, list]
+    # Host wall seconds per (coordinate, CD pass) — carried from
+    # CoordinateDescentResult so the run report joins diagnostics with
+    # timing without re-running anything.
+    wall_times: Dict[str, List[float]] = dataclasses.field(default_factory=dict)
 
 
 class GameEstimator:
@@ -329,6 +333,7 @@ class GameEstimator:
                     config=opt_config,
                     metrics=metrics,
                     tracker=cd_result.tracker,
+                    wall_times=cd_result.wall_times,
                 )
             )
             warm = cd_result.model  # warm start the next λ point

@@ -42,6 +42,7 @@ BENCH_FULL.md's stage-timing section.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 import time
@@ -49,7 +50,11 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from photon_tpu.obs.metrics import registry
+from photon_tpu.obs.trace import current_span_path, record_span, tracer
 from photon_tpu.utils.timed import PipelineStats, StageStats, record_pipeline
+
+logger = logging.getLogger("photon_tpu")
 
 # Queue bound between stages, in chunks. Measured on the bench host
 # (bench.py --pipeline-ab sweeps {1, 2, 4}): depth 2 is double-buffering —
@@ -157,8 +162,11 @@ def _source_thread(
     except BaseException as exc:  # noqa: BLE001 — forwarded to the consumer
         _put(out_q, _Failure(exc), stop)
     finally:
-        if gen is not None:
-            gen.close()  # shuts the decode block pool on abandonment
+        # Shuts the decode block pool on abandonment; plain (non-generator)
+        # iterators have nothing to close.
+        close = getattr(gen, "close", None)
+        if close is not None:
+            close()
 
 
 def _stage_thread(
@@ -223,10 +231,26 @@ def _run_staged(
         return
 
     stop = threading.Event()
+    # Parent span path captured HERE — the generator body first runs on the
+    # consumer thread's first next(), so this is the consumer's innermost
+    # open span. Stage threads carry it explicitly (thread-local nesting
+    # cannot cross threads), keeping the trace tree connected.
+    parent = current_span_path()
+
+    def spanned(target):
+        def run(*args):
+            with tracer().span(
+                f"pipeline-stage/{threading.current_thread().name}",
+                parent=parent,
+            ):
+                target(*args)
+
+        return run
+
     queues = [queue.Queue(maxsize=depth) for _ in range(len(stages) + 1)]
     threads = [
         threading.Thread(
-            target=_source_thread,
+            target=spanned(_source_thread),
             args=(make_source, queues[0], stats.stage(source_name), stop, source_nbytes),
             name=f"photon-pipe-{source_name}",
             daemon=True,
@@ -235,7 +259,7 @@ def _run_staged(
     for i, (name, fn, nbytes_of) in enumerate(stages):
         threads.append(
             threading.Thread(
-                target=_stage_thread,
+                target=spanned(_stage_thread),
                 args=(fn, queues[i], queues[i + 1], stats.stage(name), stop, nbytes_of),
                 name=f"photon-pipe-{name}",
                 daemon=True,
@@ -464,6 +488,19 @@ def stream_device_batches(
     finally:
         stats.wall_s = time.perf_counter() - t0
         stats.log(telemetry_label)
+        _finalize_pipeline_telemetry(telemetry_label, stats)
+
+
+def _finalize_pipeline_telemetry(label: str, stats: PipelineStats) -> None:
+    """Flush one pipeline run into the run report: stage metrics into the
+    registry plus one externally-timed span covering the whole stream.
+    Guarded — this runs in a ``finally`` while a pipeline failure may be
+    propagating, and telemetry must never mask that exception."""
+    try:
+        stats.publish(label)
+        record_span(f"pipeline/{label}", stats.wall_s)
+    except Exception:
+        logger.exception("pipeline telemetry publish failed for %s", label)
 
 
 def device_chunks_from(
@@ -491,6 +528,7 @@ def device_chunks_from(
     finally:
         stats.wall_s = time.perf_counter() - t0
         stats.log(telemetry_label)
+        _finalize_pipeline_telemetry(telemetry_label, stats)
 
 
 def materialize_game_batch(chunks: Iterator[BatchChunk]):
@@ -539,11 +577,14 @@ class ChunkReplayCache:
         self.replay_passes = 0
 
     def __iter__(self) -> Iterator[BatchChunk]:
+        reg = registry()
         if self._complete:
             self.replay_passes += 1
+            reg.counter("replay_cache_replay_passes_total").inc()
             yield from self._chunks
             return
         self.source_passes += 1
+        reg.counter("replay_cache_source_passes_total").inc()
         self._chunks, self.cached_bytes = [], 0
         caching = not self.spilled
         finished = False
@@ -554,6 +595,7 @@ class ChunkReplayCache:
                     if self.cached_bytes > self.byte_budget:
                         self.spilled, caching = True, False
                         self._chunks, self.cached_bytes = [], 0
+                        reg.counter("replay_cache_spills_total").inc()
                     else:
                         self._chunks.append(chunk)
                 yield chunk
@@ -563,3 +605,5 @@ class ChunkReplayCache:
                 self._complete = True
             elif not finished:
                 self._chunks, self.cached_bytes = [], 0
+            reg.gauge("replay_cache_cached_bytes").set(self.cached_bytes)
+            reg.gauge("replay_cache_spilled").set(int(self.spilled))

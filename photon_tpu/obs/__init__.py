@@ -1,0 +1,55 @@
+"""Unified run telemetry: trace spans + metrics registry + JSONL run report.
+
+Three pieces, one artifact:
+
+- :mod:`photon_tpu.obs.trace` — hierarchical host-wall spans
+  (``span("cd/iter3/per-user/solve")``), thread-safe, nestable across the
+  ingest pipeline's stage threads.
+- :mod:`photon_tpu.obs.metrics` — process-global counters / gauges /
+  histograms with labels; the solve cache, pipeline stages, replay cache,
+  shape bucketing, and optimizers all publish here.
+- :mod:`photon_tpu.obs.report` — the run-report finalizer: spans + metrics
+  + coordinate-descent tracker + environment as schema-stable JSONL
+  (``--telemetry-out`` on every CLI driver) and as
+  ``PhotonOptimizationLogEvent`` payloads.
+
+Drivers call :func:`begin_run` at entry (fresh spans/metrics/phase timers —
+stale state from a previous in-process invocation never leaks into this
+run's report) and ``finalize_run_report`` at exit.
+"""
+
+from photon_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry,
+    registry,
+    reset_registry,
+)
+from photon_tpu.obs.report import (  # noqa: F401
+    TELEMETRY_SCHEMA,
+    collect_run_records,
+    finalize_run_report,
+    validate_record,
+    write_run_report,
+)
+from photon_tpu.obs.trace import (  # noqa: F401
+    SpanRecord,
+    current_span_path,
+    get_spans,
+    record_span,
+    reset_tracer,
+    span,
+    tracer,
+)
+
+
+def begin_run() -> None:
+    """Reset all run-scoped telemetry state: spans, registry metrics, the
+    ``Timed`` phase records, and the shared solve-cache counters (compiled
+    executables are kept — only the counters are run-scoped), so a second
+    driver invocation in one process starts from a clean slate."""
+    from photon_tpu.algorithm.solve_cache import default_cache
+    from photon_tpu.utils.timed import Timed
+
+    reset_tracer()
+    reset_registry()
+    Timed.reset()
+    default_cache().reset_stats()

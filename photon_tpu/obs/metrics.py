@@ -1,0 +1,199 @@
+"""Process-global metrics registry: counters, gauges, histograms with labels.
+
+The registry is where every subsystem's counters LAND — solve-cache
+traces/hits/calls per key, pipeline stage busy/starved/backpressured wall,
+replay-cache bytes and spills, shape-bucket pad waste per dim, optimizer
+iterations and convergence reasons — replacing the habit of each subsystem
+growing a private stats dataclass nobody else can find. The private
+dataclasses (``SolveCacheStats``, ``StageStats``, …) remain as the cheap
+accumulation mechanism on their hot paths and PUBLISH here at natural
+flush points (pipeline finalize, report finalize), so reading the registry
+never perturbs a hot loop.
+
+Instruments are keyed by ``(name, sorted(labels))``; every mutation takes
+the instrument's own lock, so concurrent stage threads can increment the
+same counter without losing updates (tests/test_telemetry.py hammers this).
+Values are plain Python numbers — publishing a device array here would
+force a host sync, so callers convert exactly once, at finalize.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    kind = "instrument"
+
+    def __init__(self, name: str, labels: LabelKey):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+
+    def label_dict(self) -> Dict[str, str]:
+        return dict(self.labels)
+
+
+class Counter(_Instrument):
+    """Monotonically-increasing count (events, items, bytes)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: LabelKey):
+        super().__init__(name, labels)
+        self._value = 0
+
+    def inc(self, amount: float = 1) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({amount})")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def as_dict(self) -> dict:
+        return dict(record="metric", metric=self.name, type=self.kind,
+                    labels=self.label_dict(), value=self.value, stats=None)
+
+
+class Gauge(_Instrument):
+    """Last-write-wins value (occupancy, cached bytes, wall seconds)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: LabelKey):
+        super().__init__(name, labels)
+        self._value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def add(self, amount: float) -> None:
+        with self._lock:
+            self._value = (self._value or 0) + amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def as_dict(self) -> dict:
+        return dict(record="metric", metric=self.name, type=self.kind,
+                    labels=self.label_dict(), value=self.value, stats=None)
+
+
+class Histogram(_Instrument):
+    """Streaming summary (count/sum/min/max) — enough for the report's
+    distribution columns without unbounded per-observation storage."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: LabelKey):
+        super().__init__(name, labels)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    @property
+    def mean(self) -> Optional[float]:
+        with self._lock:
+            return self.sum / self.count if self.count else None
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            stats = dict(
+                count=self.count,
+                sum=self.sum,
+                min=self.min,
+                max=self.max,
+                mean=self.sum / self.count if self.count else None,
+            )
+        return dict(record="metric", metric=self.name, type=self.kind,
+                    labels=self.label_dict(), value=None, stats=stats)
+
+
+class MetricsRegistry:
+    """Label-aware instrument store. ``counter/gauge/histogram`` create on
+    first use and return the same instrument for the same (name, labels)
+    thereafter; a name cannot change kind."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[Tuple[str, LabelKey], _Instrument] = {}
+
+    def _get(self, cls, name: str, labels: Dict[str, object]):
+        key = (name, _label_key(labels))
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is None:
+                inst = cls(name, key[1])
+                self._instruments[key] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {inst.kind}, "
+                    f"requested {cls.kind}"
+                )
+        return inst
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def find(self, name: str, **labels) -> Optional[_Instrument]:
+        """Lookup without creating (tests, bench readers)."""
+        with self._lock:
+            return self._instruments.get((name, _label_key(labels)))
+
+    def collect(self, prefix: str = "") -> List[_Instrument]:
+        with self._lock:
+            return [
+                inst
+                for (name, _), inst in sorted(self._instruments.items())
+                if name.startswith(prefix)
+            ]
+
+    def snapshot(self) -> List[dict]:
+        """One report-ready dict per instrument (the ``metric`` JSONL
+        record shape)."""
+        return [inst.as_dict() for inst in self.collect()]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry every subsystem publishes into."""
+    return _REGISTRY
+
+
+def reset_registry() -> None:
+    _REGISTRY.reset()

@@ -1,0 +1,175 @@
+"""Hierarchical trace spans: host-wall attribution for one training run.
+
+The span tree is the run-report's answer to "where did this run spend its
+time" — the hierarchical wall-clock attribution Snap ML (arxiv 1803.06333)
+and the pjit/TPUv4 scaling work (arxiv 2204.06514) use to find the next
+bottleneck: data path vs. solver vs. compile, per coordinate and per CD
+pass, in one tree instead of four subsystems' private logs.
+
+Contract (the sync-free dispatch rule): spans measure HOST wall only —
+``time.monotonic`` around whatever the ``with`` body does. A span around a
+jitted dispatch under ``CoordinateDescent.run(profile=False)`` therefore
+times enqueue cost, never device execution, and introduces zero
+``block_until_ready`` host syncs (tests/test_solve_cache.py pins this).
+
+Nesting is thread-local by default: a span opened inside another span on
+the same thread becomes its child (path ``parent/child``). Work handed to
+another thread — the ingest pipeline's stage threads — passes the parent
+path EXPLICITLY (``span(name, parent=path)``), so the tree stays connected
+across threads without any global ambient state leaking between runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator, List, Optional
+
+SEP = "/"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanRecord:
+    """One finished span. ``start_s`` is relative to the tracer epoch
+    (reset at driver entry), so the report is stable across machines."""
+
+    name: str  # full hierarchical path, e.g. "cd/iter3/per-user/solve"
+    parent: Optional[str]  # full path of the enclosing span (None = root)
+    start_s: float
+    duration_s: float
+    thread: str
+
+    def as_dict(self) -> dict:
+        return dict(
+            record="span",
+            name=self.name,
+            parent=self.parent,
+            start_s=round(self.start_s, 6),
+            duration_s=round(self.duration_s, 6),
+            thread=self.thread,
+        )
+
+
+class Tracer:
+    """Thread-safe span collector. One process-global instance backs the
+    module-level helpers; tests may build private ones."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: List[SpanRecord] = []
+        self._local = threading.local()
+        self._epoch = time.monotonic()
+        self.epoch_unix_s = time.time()
+
+    # -- thread-local nesting stack ---------------------------------------
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current_path(self) -> Optional[str]:
+        """Full path of the innermost open span on THIS thread (None at
+        top level). Capture it before handing work to another thread and
+        pass it as ``parent=`` there."""
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    # -- recording ---------------------------------------------------------
+
+    @contextmanager
+    def span(self, name: str, parent: Optional[str] = None) -> Iterator[str]:
+        """Time the body; record one SpanRecord on exit (exceptions
+        included — a failed phase still shows its wall). Yields the full
+        path so callers can hand it to worker threads."""
+        base = parent if parent is not None else self.current_path()
+        path = f"{base}{SEP}{name}" if base else name
+        stack = self._stack()
+        stack.append(path)
+        t0 = time.monotonic()
+        try:
+            yield path
+        finally:
+            dt = time.monotonic() - t0
+            if stack and stack[-1] == path:
+                stack.pop()
+            self._append(
+                SpanRecord(path, base, t0 - self._epoch, dt,
+                           threading.current_thread().name)
+            )
+
+    def record(
+        self,
+        name: str,
+        duration_s: float,
+        parent: Optional[str] = None,
+        start_s: Optional[float] = None,
+    ) -> SpanRecord:
+        """Record an externally-timed span (e.g. a generator whose lifetime
+        was measured by its own try/finally)."""
+        base = parent if parent is not None else self.current_path()
+        path = f"{base}{SEP}{name}" if base else name
+        if start_s is None:
+            start_s = time.monotonic() - self._epoch - duration_s
+        rec = SpanRecord(path, base, start_s, duration_s,
+                         threading.current_thread().name)
+        self._append(rec)
+        return rec
+
+    def _append(self, rec: SpanRecord) -> None:
+        with self._lock:
+            self._spans.append(rec)
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def spans(self) -> List[SpanRecord]:
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        """New run: drop finished spans and restart the epoch. Open spans
+        on other threads finish into the new run (they cannot be
+        retroactively unwound); drivers reset at entry, before any spans
+        open."""
+        with self._lock:
+            self._spans.clear()
+            self._epoch = time.monotonic()
+            self.epoch_unix_s = time.time()
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    """The process-global tracer every subsystem records into."""
+    return _TRACER
+
+
+@contextmanager
+def span(name: str, parent: Optional[str] = None) -> Iterator[str]:
+    with _TRACER.span(name, parent=parent) as path:
+        yield path
+
+
+def record_span(
+    name: str,
+    duration_s: float,
+    parent: Optional[str] = None,
+    start_s: Optional[float] = None,
+) -> SpanRecord:
+    return _TRACER.record(name, duration_s, parent=parent, start_s=start_s)
+
+
+def current_span_path() -> Optional[str]:
+    return _TRACER.current_path()
+
+
+def get_spans() -> List[SpanRecord]:
+    return _TRACER.spans()
+
+
+def reset_tracer() -> None:
+    _TRACER.reset()

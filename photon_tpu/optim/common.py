@@ -105,6 +105,20 @@ class OptimizeResult:
     def convergence_reason(self) -> ConvergenceReason:
         return _REASONS[int(self.reason_code)]
 
+    def diagnostics_dict(self) -> dict:
+        """Report-ready host scalars. This is a device→host read, so call it
+        only at run-report finalize — never inside the dispatch loop."""
+        return dict(
+            type="fixed_effect",
+            iterations=int(self.iterations),
+            value=float(self.value),
+            grad_norm=float(self.grad_norm),
+            reason=self.convergence_reason.value,
+            converged=bool(self.converged),
+            evals=int(self.evals),
+            eval_unit=self.eval_unit,
+        )
+
     def summary(self) -> str:
         """Human-readable per-iteration table (tracker toSummaryString)."""
         n = int(self.iterations)

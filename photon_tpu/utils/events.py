@@ -12,8 +12,11 @@ from __future__ import annotations
 
 import dataclasses
 import importlib
+import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("photon_tpu")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,7 +71,17 @@ class EventEmitter:
             self._listeners.clear()
 
     def emit(self, event: Event) -> None:
+        """Deliver to every listener. Each call is isolated: one raising
+        listener is logged (with traceback) and the rest still receive the
+        event — a misbehaving observer must never abort the run or starve
+        later listeners of lifecycle events."""
         with self._lock:
             listeners = list(self._listeners)
         for l in listeners:
-            l(event)
+            try:
+                l(event)
+            except Exception:
+                logger.exception(
+                    "event listener %r failed on %s (delivery continues)",
+                    l, event.name,
+                )

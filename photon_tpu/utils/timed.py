@@ -24,21 +24,55 @@ logger = logging.getLogger("photon_tpu")
 
 
 class Timed:
-    """Context-manager timer that logs and records wall time per phase."""
+    """Context-manager timer that logs and records wall time per phase.
+
+    ``records`` is process-global (driver summaries read it after the run),
+    so it is guarded by a lock (phases can finish on pipeline worker
+    threads) and cleared by ``reset()`` at driver entry — without the
+    reset, a second driver invocation in the same process reported the
+    previous run's stale phases in its summary. Each finished phase also
+    lands as a trace span (obs/trace), so the run report sees every
+    ``Timed`` block without callers changing anything.
+    """
 
     records: Dict[str, float] = {}
+    _records_lock = threading.Lock()
 
     def __init__(self, name: str):
         self.name = name
         self.elapsed = 0.0
 
+    @classmethod
+    def records_lock(cls) -> threading.Lock:
+        """Lock guarding ``records`` — hold it to snapshot consistently."""
+        return cls._records_lock
+
+    @classmethod
+    def reset(cls) -> None:
+        """New run: drop phase records (and the per-label pipeline
+        telemetry that follows the same process-global pattern)."""
+        with cls._records_lock:
+            cls.records.clear()
+        _pipeline_records.clear()
+
     def __enter__(self) -> "Timed":
+        self._span = None
+        try:
+            from photon_tpu.obs.trace import tracer
+
+            self._span = tracer().span(self.name)
+            self._span.__enter__()
+        except Exception:  # telemetry must never break the timed body
+            self._span = None
         self._t0 = time.monotonic()
         return self
 
     def __exit__(self, *exc) -> None:
         self.elapsed = time.monotonic() - self._t0
-        Timed.records[self.name] = self.elapsed
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+        with Timed._records_lock:
+            Timed.records[self.name] = self.elapsed
         logger.info("[timed] %s: %.3fs", self.name, self.elapsed)
 
 
@@ -155,6 +189,35 @@ class PipelineStats:
 
     def log(self, prefix: str = "ingest-pipeline") -> None:
         logger.info("[timed] %s: %s", prefix, self.summary())
+
+    def publish(self, label: str) -> None:
+        """Flush this run's stage telemetry into the process-global metrics
+        registry (obs/metrics) so the run report carries pipeline occupancy
+        next to solver and cache metrics. Called once at pipeline finalize;
+        the per-chunk hot path only ever touches the local dataclasses."""
+        from photon_tpu.obs.metrics import registry
+
+        reg = registry()
+        reg.gauge("pipeline_wall_seconds", label=label).set(self.wall_s)
+        reg.gauge("pipeline_overlapped", label=label).set(int(self.overlapped))
+        busy = sum(s.busy_s for s in self.stages)
+        reg.gauge("pipeline_overlap_factor", label=label).set(
+            busy / self.wall_s if self.wall_s > 0 else 0.0
+        )
+        for s in self.stages:
+            kw = dict(label=label, stage=s.name)
+            reg.gauge("pipeline_stage_busy_seconds", **kw).set(s.busy_s)
+            reg.gauge("pipeline_stage_starved_seconds", **kw).set(s.wait_in_s)
+            reg.gauge("pipeline_stage_backpressured_seconds", **kw).set(
+                s.wait_out_s
+            )
+            reg.gauge("pipeline_stage_occupancy", **kw).set(s.occupancy)
+            reg.counter("pipeline_stage_items_total", **kw).inc(s.items)
+            reg.counter("pipeline_stage_bytes_total", **kw).inc(s.bytes)
+            reg.gauge("pipeline_stage_queue_depth_max", **kw).set(s.depth_max)
+            reg.gauge("pipeline_stage_queue_depth_avg", **kw).set(
+                s.depth_sum / s.depth_samples if s.depth_samples else 0.0
+            )
 
 
 # Most-recent pipeline telemetry per label, for driver summaries (the same

@@ -247,3 +247,62 @@ def test_profile_flag_controls_sync(monkeypatch):
     res = run(profile=True)
     assert calls["n"] >= 2  # one sync per coordinate update
     assert all(t > 0 for t in res.wall_times["per_user"])
+
+
+def test_full_telemetry_stays_sync_free(monkeypatch, tmp_path):
+    """The telemetry tentpole must not reintroduce host syncs: with spans,
+    metrics, AND a registered event listener all active, run(profile=False)
+    still performs ZERO block_until_ready calls. Device-resident diagnostics
+    are read exactly once, at report finalize."""
+    from photon_tpu.algorithm.coordinate_descent import CoordinateDescent
+    from photon_tpu.obs import begin_run, finalize_run_report, get_spans
+    from photon_tpu.utils.events import EventEmitter
+
+    eids, X, y, w = _clustered_problem()
+    ds = _dataset(eids, X, y, w, bucketed=True)
+    batch = _batch(eids, X, y, w)
+
+    calls = {"n": 0}
+    real = jax.block_until_ready
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting)
+
+    begin_run()
+    events = []
+    emitter = EventEmitter()
+    emitter.register(events.append)
+    coord = _coordinate(ds, SolveCache(donate=True))
+    cd = CoordinateDescent(
+        coordinates={"per_user": coord},
+        update_sequence=["per_user"],
+        num_iterations=2,
+    )
+    calls["n"] = 0
+    res = cd.run(batch, profile=False, emitter=emitter)
+    assert calls["n"] == 0  # full telemetry, zero syncs in the loop
+
+    # Spans were recorded for every coordinate update without syncing.
+    names = {s.name for s in get_spans()}
+    assert {"cd/iter0/per_user", "cd/iter1/per_user"} <= names
+    assert sum(1 for n in names if n.endswith("/solve")) == 2
+    assert sum(1 for n in names if n.endswith("/score")) == 2
+
+    # Per-update events were emitted, but sync-free: no device-read summary.
+    logs = [e for e in events if e.name == "PhotonOptimizationLogEvent"]
+    assert len(logs) == 2
+    assert all(e.payload["summary"] is None for e in logs)
+
+    # Finalize reads device-resident diagnostics — syncs are allowed HERE,
+    # once, outside the dispatch loop.
+    out = tmp_path / "run.jsonl"
+    finalize_run_report(
+        "test", path=str(out), emitter=emitter,
+        trackers=[{"label": "cd", "tracker": res.tracker,
+                   "wall_times": res.wall_times}],
+    )
+    assert out.exists()
+    begin_run()
