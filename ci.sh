@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -106,6 +106,100 @@ EOF
     rm -rf "$tmp"
 }
 
+run_active_set() {
+    # Gated-vs-full smoke for the convergence-gated active-set RE passes:
+    # a 3-pass synthetic GAME workload run twice must reach the SAME final
+    # objective (rtol 1e-5), skip entities from pass 2 on, and keep the
+    # solve-cache trace counter identical to the full run. Timing is NOT
+    # asserted here (CI machines vary); bench.py --active-set-ab measures
+    # the wall-clock side.
+    echo "== active-set: 3-pass gated-vs-full parity smoke =="
+    python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+
+from photon_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_tpu.algorithm.fixed_effect import FixedEffectCoordinate
+from photon_tpu.algorithm.random_effect import RandomEffectCoordinate
+from photon_tpu.algorithm.solve_cache import SolveCache
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.random_effect import (
+    RandomEffectDataConfig, build_random_effect_dataset,
+)
+from photon_tpu.ops.losses import LogisticLoss
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.factory import OptimizerSpec
+from photon_tpu.types import OptimizerType, TaskType
+from photon_tpu.utils.events import EventEmitter
+
+rng = np.random.default_rng(7)
+E, d_re, d_fe = 96, 6, 5
+counts = rng.integers(37, 47, size=E)
+eids = np.repeat(np.arange(E, dtype=np.int32), counts)
+n = eids.size
+Xr = rng.normal(size=(n, d_re)).astype(np.float32)
+Xr[eids % 3 != 0] = 0.0  # cold cohort: retires from pass 2 deterministically
+Xf = rng.normal(size=(n, d_fe)).astype(np.float32)
+Xf[:, 0] = 1.0
+y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+w = np.ones(n, np.float32)
+batch = GameBatch(
+    label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+    weight=jnp.asarray(w),
+    features={"global": jnp.asarray(Xf), "re": jnp.asarray(Xr)},
+    entity_ids={"userId": jnp.asarray(eids)},
+)
+ds = build_random_effect_dataset(
+    eids, Xr, y, w, E,
+    RandomEffectDataConfig(re_type="userId", feature_shard="re", n_buckets=4,
+                           shape_bucketing=True, subspace_projection=False),
+)
+
+def run(active):
+    cache = SolveCache(donate=True)
+    fe = FixedEffectCoordinate(
+        coordinate_id="global", feature_shard="global",
+        task=TaskType.LOGISTIC_REGRESSION,
+        objective=GLMObjective(loss=LogisticLoss, l2_weight=1.0,
+                               intercept_index=0),
+        optimizer_spec=OptimizerSpec(optimizer=OptimizerType.LBFGS,
+                                     max_iter=50, tol=1e-9),
+        solve_cache=cache,
+    )
+    re = RandomEffectCoordinate(
+        coordinate_id="per_user", dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        objective=GLMObjective(loss=LogisticLoss, l2_weight=0.5),
+        optimizer_spec=OptimizerSpec(optimizer=OptimizerType.NEWTON,
+                                     max_iter=25, tol=1e-9),
+        solve_cache=cache, active_set=active, convergence_tol=1e-4,
+    )
+    events = []
+    em = EventEmitter(); em.register(events.append)
+    cd = CoordinateDescent(coordinates={"global": fe, "per_user": re},
+                           update_sequence=["global", "per_user"],
+                           num_iterations=3)
+    res = cd.run(batch, profile=True, emitter=em)
+    total = np.asarray(res.model.get("global").score(batch)
+                       + res.model.get("per_user").score(batch))
+    obj = float(np.mean(w * np.logaddexp(0.0, -(2 * y - 1) * total)))
+    stats = [e.payload["active_set"] for e in events
+             if e.name == "PhotonOptimizationLogEvent"
+             and e.payload.get("coordinate") == "per_user"]
+    return obj, cache.stats.traces, stats
+
+obj_f, traces_f, _ = run(False)
+obj_g, traces_g, stats = run(True)
+rel = abs(obj_g - obj_f) / max(abs(obj_f), 1e-30)
+assert rel <= 1e-5, f"parity violated: {obj_f} vs {obj_g} (rel {rel:.3g})"
+assert traces_f == traces_g, f"trace counters differ: {traces_f} vs {traces_g}"
+skipped = [s["entities_skipped"] for s in stats]
+assert skipped[0] == 0 and all(s > 0 for s in skipped[1:]), skipped
+print(f"   objective {obj_g:.6f} (rel {rel:.1e}), traces {traces_g}, "
+      f"skipped/pass {skipped} OK")
+EOF
+}
+
 run_install() {
     echo "== packaging: editable install + console entry points =="
     tmp="$(mktemp -d)"
@@ -133,8 +227,9 @@ case "$stage" in
     unit) run_unit ;;
     dryrun) run_dryrun ;;
     telemetry) run_telemetry ;;
+    active-set) run_active_set ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

@@ -217,6 +217,13 @@ class CoordinateDescent:
                 if cid in self.locked:
                     continue
                 coord = self.coordinates[cid]
+                # Pass-boundary hook (duck-typed): active-set coordinates
+                # reset their mask state when a descent (re)starts at
+                # iteration 0, so reusing a coordinate object across runs
+                # always begins with a full pass.
+                begin_pass = getattr(coord, "begin_cd_pass", None)
+                if begin_pass is not None:
+                    begin_pass(it)
                 t0 = time.monotonic()
                 # Residual: all OTHER coordinates' scores
                 # (summedScores − thisCoordinateScores, reference :441-446).
@@ -259,6 +266,13 @@ class CoordinateDescent:
                                 diag.summary()
                                 if profile and hasattr(diag, "summary")
                                 else None
+                            ),
+                            # Active-set accounting: host ints the coordinate
+                            # derived from masks it had ALREADY fetched at
+                            # the pass boundary — reading them here adds no
+                            # sync. None for ungated coordinates.
+                            active_set=getattr(
+                                coord, "last_active_set_stats", None
                             ),
                         )
                     )

@@ -20,16 +20,23 @@ exactly like the reference's tracker.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_tpu.algorithm.coordinate import Coordinate
 from photon_tpu.algorithm.solve_cache import SolveCache, default_cache
 from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.game_data import GameBatch
-from photon_tpu.data.random_effect import EntityBlock, RandomEffectDataset, pearson_feature_mask
+from photon_tpu.data.random_effect import (
+    EntityBlock,
+    RandomEffectDataset,
+    compact_entity_blocks,
+    pack_into_sizes,
+    pearson_feature_mask,
+)
 from photon_tpu.models.game import (
     DatumScoringModel,
     ProjectedRandomEffectModel,
@@ -274,6 +281,17 @@ class RandomEffectCoordinate(Coordinate):
     # config with the same static setup reuses one executable per shape
     # bucket instead of retracing each CD pass.
     solve_cache: Optional[SolveCache] = None
+    # Convergence-gated active-set passes: pass k computes a per-entity
+    # "still active" mask IN the solve graph (relative coefficient delta vs
+    # ``convergence_tol``); at the next pass boundary the host fetches those
+    # tiny (E,) masks — materialized a full pass ago, so the fetch drains no
+    # queue — and only still-active entities are re-solved, compacted onto
+    # entity allocations the first full pass already compiled (zero new
+    # retraces by construction). Converged entities keep their coefficients
+    # and scores. The mask fetch is the ONE opt-in host sync of this path;
+    # everything else preserves the sync-free dispatch invariant.
+    active_set: bool = False
+    convergence_tol: float = 1e-4
 
     def __post_init__(self):
         self.compute_variance = normalize_variance_type(self.compute_variance)
@@ -302,10 +320,31 @@ class RandomEffectCoordinate(Coordinate):
         # Memoized per-block objectives: the solver-cache key pins the
         # normalization arrays by identity, so they must be built ONCE and
         # reused across CD passes (rebuilding each pass would defeat the
-        # compile cache).
-        self._block_objectives = [
-            self._block_objective(b) for b in self.dataset.blocks
+        # compile cache). Dense blocks memoize by block dim — same-dim dense
+        # blocks share ONE objective object, which also lets the active-set
+        # path pool their entities into one compacted dispatch under one
+        # cache key. Projected blocks (content-defined col_maps) stay
+        # per-block.
+        self._block_objectives: List[GLMObjective] = []
+        obj_memo: Dict[Tuple, GLMObjective] = {}
+        for i, b in enumerate(self.dataset.blocks):
+            memo_key = (b.dim, None) if b.col_map is None else (b.dim, i)
+            obj = obj_memo.get(memo_key)
+            if obj is None:
+                obj = self._block_objective(b)
+                obj_memo[memo_key] = obj
+            self._block_objectives.append(obj)
+        # Host-side valid-row masks/counts (entity_idx >= 0), computed once
+        # at construction so active-set accounting never reads device arrays
+        # inside the dispatch loop.
+        self._block_valid_rows = [
+            np.asarray(b.entity_idx) >= 0 for b in self.dataset.blocks
         ]
+        self._block_valid_counts = [
+            int(np.sum(v)) for v in self._block_valid_rows
+        ]
+        self._total_valid_entities = int(sum(self._block_valid_counts))
+        self._reset_active_set()
 
     def _block_intercept(self, block: EntityBlock) -> Optional[int]:
         """Intercept column in BLOCK-local space (global index mapped through
@@ -313,8 +352,6 @@ class RandomEffectCoordinate(Coordinate):
         g = self.objective.intercept_index
         if g is None or block.col_map is None:
             return g
-        import numpy as np
-
         pos = np.flatnonzero(np.asarray(block.col_map) == g)
         return int(pos[0]) if pos.size else None
 
@@ -365,6 +402,138 @@ class RandomEffectCoordinate(Coordinate):
             return self.objective
         return dataclasses.replace(self.objective, intercept_index=local)
 
+    # --- active-set pass gating -------------------------------------------
+
+    def _reset_active_set(self) -> None:
+        self._cd_pass = 0
+        # [(device bool mask, src_block, src_row)] from the LAST dispatch —
+        # src maps route each mask row back to (original block, row).
+        self._pending_masks: Optional[list] = None
+        self.last_active_set_stats: Optional[dict] = None
+
+    def begin_cd_pass(self, cd_iteration: int) -> None:
+        """Pass-boundary hook, called by CoordinateDescent before this
+        coordinate's update: a descent restarting at iteration 0 begins with
+        a full (ungated) pass, discarding any mask state left over from a
+        previous run of the same coordinate object."""
+        if cd_iteration == 0:
+            self._reset_active_set()
+
+    def _fetch_active_masks(self) -> List[np.ndarray]:
+        """HOST fetch of the per-entity active masks the PREVIOUS pass
+        computed in-graph — the one opt-in sync of the active-set path. The
+        (E,) bool arrays were materialized a full CD pass ago, so the fetch
+        does not stall the dispatch pipeline. Entities of blocks that were
+        not dispatched last pass have no mask entry and stay retired (the
+        active set shrinks monotonically within a descent)."""
+        active = [np.zeros((b.num_entities,), bool) for b in self.dataset.blocks]
+        with span("re_mask_fetch"):
+            for mask_dev, sb, sr in self._pending_masks:
+                m = np.asarray(mask_dev) & (sr >= 0)
+                for b in np.unique(sb[m]):
+                    active[b][sr[m & (sb == b)]] = True
+        return active
+
+    def _compact_feature_mask(self, idxs, sb_local, sr, block_c):
+        """Gather per-entity Pearson mask rows through the same src pairs a
+        compacted block was built from (padding rows get all-ones — inert:
+        train_mask=False pins their output to the warm start)."""
+        if not self._feature_masks:
+            return None
+        parts = []
+        real = sb_local >= 0
+        for b in np.unique(sb_local[real]):
+            rows = sr[real & (sb_local == b)]
+            parts.append(self._feature_masks[idxs[b]][rows])
+        pad = int(np.sum(~real))
+        if pad:
+            parts.append(jnp.ones((pad, block_c.dim), parts[0].dtype))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def _identity_entry(self, i: int):
+        """Dispatch-plan entry for original block i (identity src maps;
+        shape-bucket padding rows carry (-1, -1) so per-pass accounting and
+        the next mask fetch both see only real entities)."""
+        b = self.dataset.blocks[i]
+        valid = self._block_valid_rows[i]
+        return (
+            b,
+            self._block_objectives[i],
+            self._feature_masks.get(i),
+            np.where(valid, i, -1).astype(np.int32),
+            np.where(valid, np.arange(b.num_entities), -1).astype(np.int32),
+        )
+
+    def _dense_dispatch_entries(self, keep: List[np.ndarray]) -> list:
+        """Dispatch plan for a gated dense pass: group same-geometry blocks,
+        pool their still-active rows, and repack them onto entity
+        allocations the first full pass already compiled (zero new retraces
+        by construction — see data/random_effect.pack_into_sizes). Falls
+        back to whole-block skipping when repacking would not shrink the
+        dispatched allocation."""
+        groups: Dict[Tuple[int, int], List[int]] = {}
+        for i, b in enumerate(self.dataset.blocks):
+            groups.setdefault((b.n_max, b.dim), []).append(i)
+        entries = []
+        for idxs in groups.values():
+            keeps = [keep[i] for i in idxs]
+            live = [i for i, k in zip(idxs, keeps) if k.any()]
+            if not live:
+                continue  # whole group converged: nothing to dispatch
+            members = [self.dataset.blocks[i] for i in idxs]
+            allowed = [b.num_entities for b in members]
+            total = int(sum(int(k.sum()) for k in keeps))
+            plan = pack_into_sizes(total, allowed)
+            if sum(plan) >= sum(self.dataset.blocks[i].num_entities for i in live):
+                # Repacking buys nothing over skipping the fully-converged
+                # blocks — dispatch the live originals and skip the gathers.
+                entries.extend(self._identity_entry(i) for i in live)
+                continue
+            obj = self._block_objectives[idxs[0]]
+            idx_arr = np.asarray(idxs, np.int32)
+            for block_c, sb_local, sr in compact_entity_blocks(
+                members, keeps, allowed
+            ):
+                sb = np.where(
+                    sb_local >= 0, idx_arr[np.maximum(sb_local, 0)], -1
+                ).astype(np.int32)
+                mask_c = self._compact_feature_mask(idxs, sb_local, sr, block_c)
+                entries.append((block_c, obj, mask_c, sb, sr))
+        return entries
+
+    def _publish_active_set_stats(
+        self, gated: bool, dispatched_valid: int, dispatched_alloc: int,
+        num_dispatches: int,
+    ) -> None:
+        """Host-int accounting of the pass (no device reads): how many
+        entities were re-solved vs skipped, and how much smaller the
+        dispatched entity allocation was than a full pass."""
+        if not self.active_set:
+            self.last_active_set_stats = None
+            return
+        from photon_tpu.obs.metrics import registry
+
+        total = self._total_valid_entities
+        skipped = total - dispatched_valid
+        full_alloc = int(sum(b.num_entities for b in self.dataset.blocks))
+        ratio = (dispatched_alloc / full_alloc) if full_alloc else 0.0
+        reg = registry()
+        labels = dict(coordinate=self.coordinate_id)
+        reg.gauge("re_entities_active", **labels).set(dispatched_valid)
+        reg.counter("re_entities_skipped", **labels).inc(skipped)
+        reg.histogram("re_compaction_ratio", **labels).observe(ratio)
+        self.last_active_set_stats = dict(
+            cd_pass=self._cd_pass,
+            gated=gated,
+            entities_total=total,
+            entities_active=dispatched_valid,
+            entities_skipped=skipped,
+            dispatched_blocks=num_dispatches,
+            dispatched_entity_alloc=dispatched_alloc,
+            full_entity_alloc=full_alloc,
+            compaction_ratio=ratio,
+        )
+
     def train(
         self,
         batch: GameBatch,
@@ -392,31 +561,75 @@ class RandomEffectCoordinate(Coordinate):
             if initial_model is not None
             else jnp.zeros((E, d), dtype)
         )
+        # Active-set gate: from pass 2 on (mask state + a warm model), only
+        # still-active entities are re-solved, repacked onto already-compiled
+        # shapes; converged entities keep their ``coefs`` rows untouched.
+        gated = (
+            self.active_set
+            and self._pending_masks is not None
+            and initial_model is not None
+        )
+        if gated:
+            keep = self._fetch_active_masks()
+            with span("re_compact"):
+                entries = self._dense_dispatch_entries(keep)
+        else:
+            entries = [self._identity_entry(i) for i in range(len(self.dataset.blocks))]
+        tol = self.convergence_tol if self.active_set else None
+
         # Sync-free dispatch: issue EVERY block solve before touching any
         # result — no read-modify-write of ``coefs`` between dispatches, so
         # consecutive blocks pipeline on device instead of serializing
         # through the host.
         results = []
+        pending = []
         with span("re_dispatch_blocks"):
-            for i, block in enumerate(self.dataset.blocks):
+            for block, obj, mask, sb, sr in entries:
                 offs = block.gather_offsets(total_offset)
                 w0 = self._dense_warm_start(coefs, block, d)
-                mask = self._feature_masks.get(i)
                 solver = self.solve_cache.block_solver(
-                    self._block_objectives[i], self.optimizer_spec, self._config,
-                    has_mask=mask is not None,
+                    obj, self.optimizer_spec, self._config,
+                    has_mask=mask is not None, convergence_tol=tol,
                 )
-                results.append((block, *solver(block, offs, w0, mask)))
+                if gated and self.solve_cache.max_entries is None:
+                    # Compacted shapes were all compiled during the full
+                    # first pass; a retrace here is a bug. (With a bounded
+                    # cache the entry may have been LRU-evicted — a rebuild
+                    # is then legitimate, so the assertion is skipped.)
+                    with self.solve_cache.expect_cached(
+                        f"active-set dispatch {tuple(block.features.shape)}"
+                    ):
+                        out = solver(block, offs, w0, mask)
+                else:
+                    out = solver(block, offs, w0, mask)
+                if tol is not None:
+                    w, iters, reasons, act = out
+                    pending.append((act, sb, sr))
+                else:
+                    w, iters, reasons = out
+                results.append((block, w, iters, reasons))
+        if tol is not None:
+            self._pending_masks = pending
+        self._publish_active_set_stats(
+            gated,
+            dispatched_valid=int(sum(int(np.sum(sb >= 0)) for *_x, sb, _sr in entries)),
+            dispatched_alloc=int(sum(e[0].num_entities for e in entries)),
+            num_dispatches=len(entries),
+        )
+        self._cd_pass += 1
 
-        # One scatter for the whole pass: per-block outputs (sliced back to
-        # the dataset width) concatenate and write once; shape-bucket
-        # padding rows target out-of-range row E and are dropped.
-        if results:
-            idx = jnp.concatenate(
-                [jnp.where(b.entity_idx >= 0, b.entity_idx, E) for b, *_ in results]
+        # Per-block scatters (still async-dispatched, no host sync): each
+        # scatter's signature depends only on that block's (E_alloc,) shape,
+        # which the full first pass already compiled — so a gated pass that
+        # dispatches a different NUMBER of blocks reuses the same executables.
+        # (A single whole-pass concatenate+scatter would bake the block count
+        # into the eager-op signature and recompile at the first compaction.)
+        # Shape-bucket padding rows target out-of-range row E and are dropped.
+        for b, w, _i, _r in results:
+            idx = jnp.where(b.entity_idx >= 0, b.entity_idx, E)
+            coefs = coefs.at[idx].set(
+                w[:, :d].astype(coefs.dtype), mode="drop"
             )
-            w_all = jnp.concatenate([w[:, :d] for _b, w, _i, _r in results])
-            coefs = coefs.at[idx].set(w_all.astype(coefs.dtype), mode="drop")
 
         variances = None
         if self.compute_variance != VarianceComputationType.NONE:
@@ -449,26 +662,76 @@ class RandomEffectCoordinate(Coordinate):
     ) -> Tuple[ProjectedRandomEffectModel, RandomEffectTrackerStats]:
         """Per-block solves in the compact subspace: nothing of width
         ``d_full`` is ever materialized (model projection lives in the
-        block's col_map)."""
+        block's col_map).
+
+        Active-set gating is WHOLE-BLOCK here: a projected block's
+        content-defined col_map width cannot merge with another block's
+        without a new shape (= a retrace), so a block is skipped only once
+        every one of its entities has converged — its previous coefficients
+        carry over untouched."""
         entity_block, entity_row, inv_maps = self.dataset.projection_tables()
+        gated = (
+            self.active_set
+            and self._pending_masks is not None
+            and isinstance(initial_model, ProjectedRandomEffectModel)
+        )
+        keep = self._fetch_active_masks() if gated else None
+        tol = self.convergence_tol if self.active_set else None
         parts = []
+        pending = []
+        dispatched_valid = dispatched_alloc = num_dispatches = 0
         block_coefs, block_vars, col_maps, block_offs = [], [], [], []
         # Sync-free dispatch: every block solve is issued before any
         # dependent work (variances) touches the outputs.
         with span("re_dispatch_blocks"):
             for i, block in enumerate(self.dataset.blocks):
                 offs = block.gather_offsets(total_offset)
+                col_maps.append(block.col_map)
+                block_offs.append(offs)
+                if gated and not keep[i].any():
+                    prev = initial_model.block_coefs[i]
+                    if prev.shape == (block.num_entities, block.dim):
+                        # Fully-converged block: carry the warm coefficients
+                        # (aliasing is safe — model arrays are never donated;
+                        # _initial_block_coefs copies before a donated solve).
+                        block_coefs.append(prev)
+                        continue
                 w0 = self._initial_block_coefs(block, i, initial_model)
                 obj = self._block_objectives[i]
                 mask = self._feature_masks.get(i)
                 solver = self.solve_cache.block_solver(
-                    obj, self.optimizer_spec, self._config, has_mask=mask is not None
+                    obj, self.optimizer_spec, self._config,
+                    has_mask=mask is not None, convergence_tol=tol,
                 )
-                w_new, iters, reasons = solver(block, offs, w0, mask)
+                if gated and self.solve_cache.max_entries is None:
+                    with self.solve_cache.expect_cached(
+                        f"active-set dispatch {tuple(block.features.shape)}"
+                    ):
+                        out = solver(block, offs, w0, mask)
+                else:
+                    out = solver(block, offs, w0, mask)
+                if tol is not None:
+                    w_new, iters, reasons, act = out
+                    pending.append(
+                        (
+                            act,
+                            np.full((block.num_entities,), i, np.int32),
+                            np.arange(block.num_entities, dtype=np.int32),
+                        )
+                    )
+                else:
+                    w_new, iters, reasons = out
                 block_coefs.append(w_new)
-                col_maps.append(block.col_map)
-                block_offs.append(offs)
                 parts.append((block.entity_idx, iters, reasons))
+                dispatched_valid += self._block_valid_counts[i]
+                dispatched_alloc += block.num_entities
+                num_dispatches += 1
+        if tol is not None:
+            self._pending_masks = pending
+        self._publish_active_set_stats(
+            gated, dispatched_valid, dispatched_alloc, num_dispatches
+        )
+        self._cd_pass += 1
         if self.compute_variance != VarianceComputationType.NONE:
             for i, block in enumerate(self.dataset.blocks):
                 obj = self._block_objectives[i]

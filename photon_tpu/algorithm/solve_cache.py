@@ -42,13 +42,23 @@ nest on every ``train()`` call.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import os
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 
 Array = jax.Array
+
+# Bounded-cache opt-in: entry cap for every SolveCache constructed without an
+# explicit ``max_entries`` (default unbounded — today a λ-sweep is one entry
+# per λ, which is fine; the env knob exists for per-λ-objective sweeps that
+# blow up the entry count).
+MAX_ENTRIES_ENV = "PHOTON_TPU_SOLVE_CACHE_MAX_ENTRIES"
 
 
 @dataclasses.dataclass
@@ -66,6 +76,7 @@ class SolveCacheStats:
     traces: int = 0
     calls: int = 0
     hits: int = 0
+    evictions: int = 0
     trace_keys: List[Tuple] = dataclasses.field(default_factory=list)
 
     def as_dict(self) -> Dict[str, Any]:
@@ -73,6 +84,7 @@ class SolveCacheStats:
             traces=self.traces,
             calls=self.calls,
             hits=self.hits,
+            evictions=self.evictions,
             trace_keys=[list(k) for k in self.trace_keys],
         )
 
@@ -99,11 +111,21 @@ class SolveCache:
     reuse the w0 buffer after the solve).
     """
 
-    def __init__(self, donate: bool = True):
+    def __init__(self, donate: bool = True, max_entries: Optional[int] = None):
         self.donate = donate
+        if max_entries is None:
+            env = os.environ.get(MAX_ENTRIES_ENV, "").strip()
+            max_entries = int(env) if env else None
+        if max_entries is not None and max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        # LRU bound on ENTRIES (per-key executables). Evicting an entry only
+        # drops the cache's reference + pins — a solver callable a caller
+        # already holds keeps working (jax.jit owns its own executables); a
+        # later dispatch of the same key rebuilds (and re-traces) it.
+        self.max_entries = max_entries
         self.stats = SolveCacheStats()
-        self._fns: Dict[Tuple, Callable] = {}
-        self._pins: List[Tuple] = []  # keep id()-keyed objects alive
+        self._fns: "OrderedDict[Tuple, Callable]" = OrderedDict()
+        self._pins: Dict[Tuple, Tuple] = {}  # keep id()-keyed objects alive
         self._lock = threading.Lock()
 
     # ---- static keys -----------------------------------------------------
@@ -162,7 +184,17 @@ class SolveCache:
             if fn is None:
                 fn = build()
                 self._fns[key] = fn
-                self._pins.append(pins)
+                self._pins[key] = pins
+                if self.max_entries is not None:
+                    while len(self._fns) > self.max_entries:
+                        old_key, _old_fn = self._fns.popitem(last=False)
+                        self._pins.pop(old_key, None)
+                        self.stats.evictions += 1
+                        from photon_tpu.obs.metrics import registry
+
+                        registry().counter("solve_cache_evictions_total").inc()
+            else:
+                self._fns.move_to_end(key)  # LRU touch
         return fn
 
     def _counted(self, fn: Callable) -> Callable:
@@ -180,7 +212,8 @@ class SolveCache:
         return call
 
     def block_solver(
-        self, objective, spec, config, has_mask: bool
+        self, objective, spec, config, has_mask: bool,
+        convergence_tol: Optional[float] = None,
     ) -> Callable[..., Tuple[Array, Array, Array]]:
         """Jitted ``_solve_block`` executable for one static configuration.
 
@@ -188,14 +221,26 @@ class SolveCache:
         start ``w0`` is DONATED (when ``self.donate``): callers must pass a
         buffer that is dead after the call — a fresh gather, or an explicit
         copy of any model-owned array.
+
+        With ``convergence_tol`` set (the active-set gate of
+        algorithm/random_effect.py), the traced program ALSO returns a
+        per-entity bool ``active`` mask computed in-graph: an entity stays
+        active while its coefficient delta exceeds ``tol`` relative to the
+        warm start, and shape-bucket padding rows (entity_idx == -1) are
+        never active. The tol is part of the cache key, so gated and
+        ungated dispatches never share (or invalidate) an executable;
+        ``trace_keys`` keeps the same shape-only format either way so trace
+        breakdowns of gated and ungated runs stay comparable.
         """
         has_mask = bool(has_mask)
+        tol = None if convergence_tol is None else float(convergence_tol)
         key = (
             "block",
             self._objective_key(objective),
             self._spec_key(spec),
             self._config_key(config),
             has_mask,
+            tol,
         )
 
         def build():
@@ -203,25 +248,36 @@ class SolveCache:
 
             stats = self.stats
 
+            def solve(block, offsets, w0, feature_mask=None):
+                stats.traces += 1
+                stats.trace_keys.append(
+                    ("block",) + tuple(block.features.shape) + (has_mask,)
+                )
+                out = _solve_block(
+                    block, offsets, w0, objective, spec, config, feature_mask
+                )
+                if tol is None:
+                    return out
+                w, iterations, reasons = out
+                # Relative coefficient movement in MODEL space; the floor of
+                # 1.0 on the reference norm makes near-zero models behave
+                # like an absolute tolerance.
+                delta = jnp.linalg.norm((w - w0).astype(jnp.float32), axis=-1)
+                ref = jnp.maximum(
+                    jnp.linalg.norm(w0.astype(jnp.float32), axis=-1), 1.0
+                )
+                active = (delta > tol * ref) & (block.entity_idx >= 0)
+                return w, iterations, reasons, active
+
             if has_mask:
 
                 def traced(block, offsets, w0, feature_mask):
-                    stats.traces += 1
-                    stats.trace_keys.append(
-                        ("block",) + tuple(block.features.shape) + (has_mask,)
-                    )
-                    return _solve_block(
-                        block, offsets, w0, objective, spec, config, feature_mask
-                    )
+                    return solve(block, offsets, w0, feature_mask)
 
             else:
 
                 def traced(block, offsets, w0):
-                    stats.traces += 1
-                    stats.trace_keys.append(
-                        ("block",) + tuple(block.features.shape) + (has_mask,)
-                    )
-                    return _solve_block(block, offsets, w0, objective, spec, config)
+                    return solve(block, offsets, w0)
 
             donate = (2,) if self.donate else ()
             return jax.jit(traced, donate_argnums=donate)
@@ -262,6 +318,26 @@ class SolveCache:
 
     # ---- introspection ---------------------------------------------------
 
+    @contextlib.contextmanager
+    def expect_cached(self, what: str = "dispatch"):
+        """Assert no NEW executable is traced inside the context.
+
+        The active-set path wraps every compacted dispatch in this: compacted
+        blocks are packed exclusively onto entity allocations that the first
+        full pass already compiled, so a retrace here is a bug (a shape that
+        escaped the allowed-size plan), not a performance wobble. Tracing
+        happens synchronously at dispatch time, so the counter check is
+        exact even though execution is async.
+        """
+        traces0, nkeys = self.stats.traces, len(self.stats.trace_keys)
+        yield
+        if self.stats.traces != traces0:
+            raise AssertionError(
+                f"{what}: expected a cache hit but traced "
+                f"{self.stats.traces - traces0} new executable(s): "
+                f"{self.stats.trace_keys[nkeys:]}"
+            )
+
     @property
     def num_entries(self) -> int:
         return len(self._fns)
@@ -284,6 +360,7 @@ class SolveCache:
             s.traces = 0
             s.calls = 0
             s.hits = 0
+            s.evictions = 0
             s.trace_keys.clear()
 
 
@@ -295,10 +372,12 @@ def default_cache() -> SolveCache:
     return _default_cache
 
 
-def reset_default_cache(donate: bool = True) -> SolveCache:
+def reset_default_cache(
+    donate: bool = True, max_entries: Optional[int] = None
+) -> SolveCache:
     """Replace the shared cache (tests / benchmark A-B sections)."""
     global _default_cache
-    _default_cache = SolveCache(donate=donate)
+    _default_cache = SolveCache(donate=donate, max_entries=max_entries)
     return _default_cache
 
 

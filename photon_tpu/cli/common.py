@@ -93,6 +93,9 @@ def parse_coordinate_config(spec: str):
         else None
     )
     kv.pop("features.to.samples.ratio", None)
+    active_set = kv.pop("active.set", "false").strip().lower() in ("1", "true", "yes")
+    conv_tol = float(kv["convergence.tol"]) if "convergence.tol" in kv else None
+    kv.pop("convergence.tol", None)
     if kv:
         raise ValueError(f"unknown coordinate keys: {sorted(kv)}")
     return RandomEffectCoordinateConfig(
@@ -101,6 +104,7 @@ def parse_coordinate_config(spec: str):
         reg_weights=reg_weights, reg_alpha=reg_alpha,
         active_upper_bound=ub, active_lower_bound=lb,
         features_to_samples_ratio=ratio,
+        active_set=active_set, convergence_tol=conv_tol,
     )
 
 
@@ -144,6 +148,27 @@ def parse_input_column_names(spec):
             )
         kwargs[key] = value.strip()
     return InputColumnsNames(**kwargs)
+
+
+def add_active_set_args(p: argparse.ArgumentParser) -> None:
+    """Convergence-gated active-set flags shared by all drivers.
+
+    Only the GAME training driver acts on them (random-effect coordinates);
+    the other drivers accept them for CLI-surface parity and warn that they
+    are no-ops there.
+    """
+    p.add_argument(
+        "--re-active-set", action="store_true",
+        help="after the first CD pass, re-solve only random-effect entities "
+             "whose coefficients still move more than --re-convergence-tol; "
+             "converged entities keep their coefficients and scores "
+             "(one small mask fetch per pass boundary)",
+    )
+    p.add_argument(
+        "--re-convergence-tol", type=float, default=1e-4,
+        help="relative coefficient-delta threshold deciding which entities "
+             "stay in the active set (default 1e-4)",
+    )
 
 
 def add_validation_arg(p: argparse.ArgumentParser) -> None:

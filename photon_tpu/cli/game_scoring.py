@@ -64,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the unified run report (spans + metrics + "
                         "ingest-pipeline occupancy) as schema-stable JSONL "
                         "to this path")
+    from photon_tpu.cli.common import add_active_set_args
+
+    add_active_set_args(p)
     return p
 
 
@@ -114,6 +117,13 @@ def _pad_game_batch(b, target_n: int):
 
 def run(args) -> Dict:
     setup_logging(args.verbose)
+    if getattr(args, "re_active_set", False):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "--re-active-set is a no-op for the scoring driver (nothing is "
+            "trained); it only affects GAME training"
+        )
     from photon_tpu.obs import begin_run, finalize_run_report
     from photon_tpu.utils.events import (
         EventEmitter,

@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.common import add_validation_arg
 
     add_validation_arg(p)
+    from photon_tpu.cli.common import add_active_set_args
+
+    add_active_set_args(p)
     p.add_argument("--validation-paths", nargs="*", default=None)
     p.add_argument("--coordinate-configurations", nargs="+", required=True)
     p.add_argument("--update-sequence", required=True,
@@ -358,6 +361,8 @@ def run(args) -> Dict:
         variance_computation=args.variance_computation,
         ignore_threshold_for_new_models=args.ignore_threshold_for_new_models,
         warm_start_model=warm,
+        re_active_set=args.re_active_set,
+        re_convergence_tol=args.re_convergence_tol,
     )
     from photon_tpu.utils.events import training_finish_event, training_start_event
 

@@ -153,6 +153,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "cache spills and later passes re-stream from disk "
                         "(host memory stays bounded either way)")
     add_validation_arg(p)
+    from photon_tpu.cli.common import add_active_set_args
+
+    add_active_set_args(p)
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -277,6 +280,11 @@ def run(args) -> Dict:
     from photon_tpu.obs import begin_run, finalize_run_report, span
 
     begin_run()  # fresh spans / metrics / phase records for THIS run
+    if getattr(args, "re_active_set", False):
+        logging.getLogger(__name__).warning(
+            "--re-active-set is a no-op for the single-GLM driver (no "
+            "random-effect coordinates); it only affects GAME training"
+        )
     task = task_of(args)
     stage = DriverStage.INIT
     emitter = EventEmitter()

@@ -397,6 +397,124 @@ def build_random_effect_dataset(
     return RandomEffectDataset(config, blocks, num_entities, d)
 
 
+def pack_into_sizes(total: int, allowed_sizes: Sequence[int]) -> List[int]:
+    """Plan compacted block sizes for ``total`` active rows using ONLY sizes
+    drawn from ``allowed_sizes`` — the entity allocations of the dataset's
+    original blocks with the same (n_max, d) geometry. Every one of those
+    allocations was compiled during the first full CD pass, so a plan drawn
+    from this set lands exclusively on already-cached executables: the
+    active-set path's zero-retrace guarantee holds by construction.
+
+    Greedy: the smallest allowed size that holds the remainder, else the
+    largest allowed size repeatedly.
+    """
+    sizes = sorted({int(s) for s in allowed_sizes})
+    if not sizes:
+        raise ValueError("pack_into_sizes needs at least one allowed size")
+    plan: List[int] = []
+    remaining = int(total)
+    while remaining > 0:
+        plan.append(next((s for s in sizes if s >= remaining), sizes[-1]))
+        remaining -= plan[-1]
+    return plan
+
+
+def compact_entity_blocks(
+    blocks: Sequence[EntityBlock],
+    keep: Sequence[np.ndarray],
+    allowed_sizes: Optional[Sequence[int]] = None,
+) -> List[Tuple[EntityBlock, np.ndarray, np.ndarray]]:
+    """Repack the still-active rows of same-geometry dense blocks into the
+    smallest already-compiled shapes (the active-set repack path).
+
+    ``blocks`` must share (n_max, dim) and be dense (``col_map is None``) —
+    projected blocks keep content-defined col_map widths that cannot merge
+    without a retrace, so they use whole-block skipping instead. ``keep[i]``
+    is a host bool array over block i's entity rows; shape-bucket padding
+    rows (entity_idx == -1) must already be False there.
+
+    Returns ``[(compacted_block, src_block, src_row), ...]``: the two int32
+    arrays are the per-row entity_gather index map — for every row of the
+    compacted block, the (source block index, source row) it was gathered
+    from, (-1, -1) on the compacted block's own padding rows. The map routes
+    the NEXT pass's per-row active masks back onto original blocks; merging
+    coefficients back needs no map at all, because compacted rows carry
+    their real ``entity_idx`` and the coordinate's single drop-mode scatter
+    already lands them.
+    """
+    if not blocks:
+        return []
+    geom = {(b.n_max, b.dim, b.col_map is None) for b in blocks}
+    if len(geom) != 1 or not next(iter(geom))[2]:
+        raise ValueError(
+            f"compact_entity_blocks needs same-geometry dense blocks, got {geom}"
+        )
+    src_block_parts, src_row_parts = [], []
+    for i, k in enumerate(keep):
+        rows = np.flatnonzero(np.asarray(k))
+        src_block_parts.append(np.full(rows.shape, i, np.int32))
+        src_row_parts.append(rows.astype(np.int32))
+    src_block = np.concatenate(src_block_parts)
+    src_row = np.concatenate(src_row_parts)
+    total = int(src_block.size)
+    if total == 0:
+        return []
+    if allowed_sizes is None:
+        allowed_sizes = [b.num_entities for b in blocks]
+    plan = pack_into_sizes(total, allowed_sizes)
+
+    n_max, d = blocks[0].n_max, blocks[0].dim
+    out: List[Tuple[EntityBlock, np.ndarray, np.ndarray]] = []
+    start = 0
+    for size in plan:
+        sb = src_block[start:start + size]
+        sr = src_row[start:start + size]
+        start += sb.size
+        pad = size - sb.size
+
+        def gather(field, pad_arr, sb=sb, sr=sr, pad=pad):
+            # Host-side numpy gather, deliberately: jnp advanced indexing
+            # would eagerly compile one XLA gather kernel per distinct
+            # selection shape — seconds of warmup landing in the first gated
+            # pass. The repack is a pass-boundary host step by design, so
+            # gather on host and ship only the compacted block to device.
+            # src pairs are sorted (block asc, row asc), so concatenating
+            # per-source gathers in block order preserves row order exactly.
+            parts = [
+                np.asarray(getattr(blocks[b], field))[sr[sb == b]]
+                for b in np.unique(sb)
+            ]
+            if pad:
+                parts.append(pad_arr)
+            return jnp.asarray(
+                parts[0] if len(parts) == 1 else np.concatenate(parts)
+            )
+
+        block_c = EntityBlock(
+            entity_idx=gather("entity_idx", np.full((pad,), -1, np.int32)),
+            features=gather(
+                "features", np.zeros((pad, n_max, d), blocks[0].features.dtype)
+            ),
+            label=gather("label", np.zeros((pad, n_max), blocks[0].label.dtype)),
+            weight=gather(
+                "weight", np.zeros((pad, n_max), blocks[0].weight.dtype)
+            ),
+            sample_index=gather(
+                "sample_index", np.full((pad, n_max), -1, np.int32)
+            ),
+            train_mask=gather("train_mask", np.zeros((pad,), bool)),
+            col_map=None,
+        )
+        out.append(
+            (
+                block_c,
+                np.concatenate([sb, np.full((pad,), -1, np.int32)]),
+                np.concatenate([sr, np.full((pad,), -1, np.int32)]),
+            )
+        )
+    return out
+
+
 def pearson_feature_mask(
     block: EntityBlock,
     max_features: Array,

@@ -74,6 +74,13 @@ class RandomEffectCoordinateConfig:
     features_to_samples_ratio: Optional[float] = None
     # VarianceComputationType (or bool/str shorthand; True → SIMPLE)
     compute_variance: object = VarianceComputationType.NONE
+    # Convergence-gated active-set CD passes (algorithm/random_effect.py):
+    # after the first full pass only entities whose coefficients still move
+    # more than ``convergence_tol`` (relative) are re-solved; converged
+    # entities keep their coefficients and scores. ``convergence_tol=None``
+    # defers to the estimator-level default.
+    active_set: bool = False
+    convergence_tol: Optional[float] = None
 
     def optimizer_spec(self) -> OptimizerSpec:
         return OptimizerSpec(self.optimizer, self.max_iter, self.tol)

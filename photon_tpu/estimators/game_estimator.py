@@ -120,6 +120,8 @@ class GameEstimator:
         variance_computation: object = None,  # VarianceComputationType/bool/str
         ignore_threshold_for_new_models: bool = False,
         warm_start_model=None,  # GameModel the flag reads existing ids from
+        re_active_set: bool = False,
+        re_convergence_tol: float = 1e-4,
     ):
         self.task = task
         self.coordinate_configs = list(coordinate_configs)
@@ -136,6 +138,11 @@ class GameEstimator:
         # construction so a mid-sweep tuning fit can never trip it.
         self.ignore_threshold_for_new_models = bool(ignore_threshold_for_new_models)
         self.warm_start_model = warm_start_model
+        # Estimator-level active-set default (per-coordinate config wins,
+        # same precedence shape as variance): convergence-gated random-
+        # effect passes for every RE coordinate of this estimator.
+        self.re_active_set = bool(re_active_set)
+        self.re_convergence_tol = float(re_convergence_tol)
         if self.ignore_threshold_for_new_models and warm_start_model is None:
             raise ValueError(
                 "'Ignore threshold for new models' flag set but no initial "
@@ -200,6 +207,12 @@ class GameEstimator:
                     objective=objective,
                     optimizer_spec=cfg.optimizer_spec(),
                     compute_variance=self._variance_type(cfg),
+                    active_set=bool(cfg.active_set or self.re_active_set),
+                    convergence_tol=(
+                        cfg.convergence_tol
+                        if cfg.convergence_tol is not None
+                        else self.re_convergence_tol
+                    ),
                 )
             else:
                 raise TypeError(f"unknown coordinate config {type(cfg)}")

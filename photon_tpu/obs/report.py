@@ -133,6 +133,7 @@ def _publish_solve_cache(reg) -> None:
     reg.gauge("solve_cache_traces").set(stats.traces)
     reg.gauge("solve_cache_calls").set(stats.calls)
     reg.gauge("solve_cache_hits").set(stats.hits)
+    reg.gauge("solve_cache_evictions").set(stats.evictions)
     reg.gauge("solve_cache_entries").set(cache.num_entries)
     per_key: Dict[str, int] = {}
     for key in stats.trace_keys:

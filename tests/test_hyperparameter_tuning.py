@@ -369,3 +369,37 @@ def test_gp_next_batch_distinct_candidates():
     X = search.next_batch(3)
     assert X.shape == (3, 2)
     assert len({tuple(np.round(row, 9)) for row in X}) == 3
+
+
+def test_tuning_loop_telemetry_spans_and_metrics():
+    """Satellite (ISSUE 4): each tuning round emits
+    tuning/round{i}/{propose,train,observe} spans, the candidate-count gauge
+    reflects the proposal batch width, and the round counter advances."""
+    import numpy as np
+
+    from photon_tpu.hyperparameter.search import RandomSearch
+    from photon_tpu.obs import begin_run
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.obs.trace import get_spans
+
+    begin_run()
+    search = RandomSearch(dim=2, evaluator=lambda x: float(np.sum(x ** 2)))
+    search.find(2)
+    names = {s.name for s in get_spans()}
+    for i in range(2):
+        for stage in ("propose", "train", "observe"):
+            assert f"tuning/round{i}/{stage}" in names, names
+    assert registry().gauge("tuning_candidate_count").value == 1
+    assert registry().counter("tuning_rounds_total").value == 2
+
+    # Batch mode: q candidates per round, same span scheme.
+    begin_run()
+    search = RandomSearch(dim=2, evaluator=lambda x: 0.0)
+    best, _val = search.find_batch(
+        2, 3, lambda X: [float(np.sum(x ** 2)) for x in X]
+    )
+    assert best.shape == (2,)
+    names = {s.name for s in get_spans()}
+    assert "tuning/round1/train" in names
+    assert registry().gauge("tuning_candidate_count").value == 3
+    assert registry().counter("tuning_rounds_total").value == 2

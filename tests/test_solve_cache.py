@@ -306,3 +306,78 @@ def test_full_telemetry_stays_sync_free(monkeypatch, tmp_path):
     )
     assert out.exists()
     begin_run()
+
+
+def test_lru_eviction_bounded_cache():
+    """PHOTON_TPU_SOLVE_CACHE_MAX_ENTRIES-style bounded cache: a λ-sweep
+    (one entry per l2_weight) stays under the cap, evictions count, the two
+    LIVE entries keep serving hits, and a solver handle whose entry was
+    evicted transparently rebuilds (a legitimate retrace, not an error)."""
+    from photon_tpu.obs.metrics import registry
+
+    eids, X, y, w = _clustered_problem()
+    ds = _dataset(eids, X, y, w, bucketed=True, n_buckets=2)
+    block = ds.blocks[0]
+    spec = OptimizerSpec(optimizer=OptimizerType.NEWTON, max_iter=10, tol=1e-9)
+    cfg = dataclasses.replace(spec.config(), track_history=False)
+    offs = block.gather_offsets(jnp.zeros(y.shape[0], jnp.float32))
+
+    def w0():
+        return jnp.zeros((block.num_entities, block.dim), jnp.float32)
+
+    cache = SolveCache(donate=False, max_entries=2)
+    counter_before = registry().counter("solve_cache_evictions_total").value
+    lams = [0.1, 0.5, 1.0, 2.0]
+    solvers, results = {}, {}
+    for lam in lams:
+        obj = GLMObjective(loss=LogisticLoss, l2_weight=lam, intercept_index=0)
+        solvers[lam] = cache.block_solver(obj, spec, cfg, has_mask=False)
+        out, *_ = solvers[lam](block, offs, w0())
+        results[lam] = np.asarray(out).copy()
+        assert cache.num_entries <= 2  # the cap holds throughout the sweep
+    assert cache.stats.traces == len(lams)
+    assert cache.stats.evictions == len(lams) - 2
+    evicted = registry().counter("solve_cache_evictions_total").value
+    assert evicted - counter_before == len(lams) - 2
+
+    # The two most-recent entries are live: re-dispatching them is a HIT.
+    hits0 = cache.stats.hits
+    for lam in lams[-2:]:
+        out, *_ = solvers[lam](block, offs, w0())
+        np.testing.assert_allclose(
+            np.asarray(out), results[lam], rtol=1e-5, atol=1e-6
+        )
+    assert cache.stats.hits == hits0 + 2
+    assert cache.stats.traces == len(lams)
+
+    # An evicted entry's HANDLE still works without a retrace: handles pin
+    # their executable, so eviction reclaims the cache slot without
+    # invalidating live callers (memory frees once no handle remains).
+    out, *_ = solvers[lams[0]](block, offs, w0())
+    np.testing.assert_allclose(
+        np.asarray(out), results[lams[0]], rtol=1e-5, atol=1e-6
+    )
+    assert cache.stats.traces == len(lams)
+
+    # A NEW handle for the evicted λ rebuilds — the entry really is gone.
+    obj0 = GLMObjective(
+        loss=LogisticLoss, l2_weight=lams[0], intercept_index=0
+    )
+    fresh = cache.block_solver(obj0, spec, cfg, has_mask=False)
+    out, *_ = fresh(block, offs, w0())
+    np.testing.assert_allclose(
+        np.asarray(out), results[lams[0]], rtol=1e-5, atol=1e-6
+    )
+    assert cache.stats.traces == len(lams) + 1
+    assert cache.num_entries <= 2
+
+
+def test_max_entries_env_and_validation(monkeypatch):
+    from photon_tpu.algorithm.solve_cache import MAX_ENTRIES_ENV
+
+    monkeypatch.setenv(MAX_ENTRIES_ENV, "3")
+    assert SolveCache().max_entries == 3
+    monkeypatch.delenv(MAX_ENTRIES_ENV)
+    assert SolveCache().max_entries is None
+    with pytest.raises(ValueError):
+        SolveCache(max_entries=0)
