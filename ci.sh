@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|serve|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -200,6 +200,133 @@ print(f"   objective {obj_g:.6f} (rel {rel:.1e}), traces {traces_g}, "
 EOF
 }
 
+run_serve() {
+    # Online-serving smoke: train a tiny GAME model, batch-score it with the
+    # game_scoring driver, then push the SAME rows through the in-process
+    # serving engine from many threads. Asserts (1) bit-parity — every
+    # micro-batched score equals the batch driver's, atol=0; (2) the
+    # in-trace retrace counter stays 0 after warm-up; (3) backpressure
+    # sheds with the explicit error.
+    echo "== serve: concurrent micro-batch parity + zero-retrace smoke =="
+    tmp="$(mktemp -d)"
+    python - "$tmp" <<'EOF'
+import os, sys, threading
+import numpy as np
+
+tmp = sys.argv[1]
+rng = np.random.default_rng(23)
+
+from photon_tpu.io.avro import write_avro_records
+from photon_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+def write_fixture(path, n, d=6, n_users=8):
+    w = np.linspace(-1, 1, d)
+    bias = np.linspace(-2, 2, n_users)
+    records = []
+    for i in range(n):
+        x = rng.normal(size=d)
+        u = i % n_users
+        y = float(rng.uniform() < 1 / (1 + np.exp(-(x @ w + bias[u]))))
+        records.append(dict(
+            uid=str(i), label=y,
+            features=[{"name": f"x{j}", "term": "", "value": float(x[j])}
+                      for j in range(d)],
+            metadataMap={"userId": f"u{u}"}, weight=1.0, offset=0.0))
+    write_avro_records(path, TRAINING_EXAMPLE_SCHEMA, records)
+
+train, valid = os.path.join(tmp, "train.avro"), os.path.join(tmp, "valid.avro")
+# 48 users > the engine's 32-row hot floor, so the hot store actually runs
+# its LRU promote/demote path (8 users would pin the whole table).
+write_fixture(train, 600, n_users=48)
+write_fixture(valid, 256, n_users=48)
+
+from photon_tpu.cli import game_scoring, game_training
+
+out = os.path.join(tmp, "out")
+game_training.run(game_training.build_parser().parse_args([
+    "--input-paths", train, "--output-dir", out,
+    "--feature-shard-configurations", "name=globalShard",
+    "--coordinate-configurations",
+    "name=global,feature.shard=globalShard,optimizer=LBFGS,reg.weights=1",
+    "name=perUser,feature.shard=globalShard,random.effect.type=userId,reg.weights=1",
+    "--update-sequence", "global,perUser",
+]))
+score_out = os.path.join(tmp, "scores")
+game_scoring.run(game_scoring.build_parser().parse_args([
+    "--input-paths", valid, "--output-dir", score_out,
+    "--feature-shard-configurations", "name=globalShard",
+    "--model-input-dir", os.path.join(out, "best"),
+    "--model-artifacts-dir", out,
+]))
+from photon_tpu.io.scores import load_scores
+batch_score = {r["uid"]: np.float32(r["predictionScore"])
+               for r in load_scores(os.path.join(score_out, "scores.avro"))}
+
+# Same rows, served: dense feature vectors from the same reader + index maps.
+from photon_tpu.cli.common import parse_feature_shard_config
+from photon_tpu.data.index_map import EntityIndex, IndexMap
+from photon_tpu.io.data_reader import read_merged
+from photon_tpu.serve import ScoreRequest, ServeConfig, load_engine
+
+imap = IndexMap.load(os.path.join(out, "index-map-globalShard.json"))
+eidx = EntityIndex.load(os.path.join(out, "entity-index-userId.json"))
+batch, _, _ = read_merged(
+    [valid], parse_feature_shard_config("name=globalShard"),
+    index_maps={"globalShard": imap},
+    entity_id_columns={"userId": "userId"},
+    entity_indexes={"userId": eidx}, intern_new_entities=False,
+)
+X = np.asarray(batch.features["globalShard"])
+eids = np.asarray(batch.entity_ids["userId"])
+uids = [str(int(u)) for u in np.asarray(batch.uid)]
+n = X.shape[0]
+
+engine = load_engine(
+    os.path.join(out, "best"), artifacts_dir=out,
+    config=ServeConfig(max_batch_size=32, max_delay_ms=5.0,
+                       # force the LRU path: budget far below the full table
+                       hot_bytes=1),
+)
+assert not engine.stats()["store"]["userId"]["pinned"], engine.stats()
+
+results = [None] * n
+def worker(lo, hi):
+    futs = [(i, engine.submit(ScoreRequest(
+        {"globalShard": X[i]}, {"userId": int(eids[i])})))
+        for i in range(lo, hi)]
+    for i, f in futs:
+        results[i] = np.float32(f.result(timeout=60))
+threads = [threading.Thread(target=worker, args=(lo, min(lo + 16, n)))
+           for lo in range(0, n, 16)]
+for t in threads: t.start()
+for t in threads: t.join()
+
+exact = sum(results[i] == batch_score[uids[i]] for i in range(n))
+assert exact == n, f"bit-parity: only {exact}/{n} scores exact"
+assert engine.retraces_since_warmup == 0, engine.stats()
+
+# Backpressure sheds with the explicit error (cap 1, pile on a 2nd+3rd).
+from photon_tpu.serve import BackpressureError
+from photon_tpu.serve.engine import ServingEngine  # noqa: F401 (doc pointer)
+shed_engine = load_engine(
+    os.path.join(out, "best"), artifacts_dir=out,
+    config=ServeConfig(max_batch_size=1, max_delay_ms=200.0, queue_cap=1))
+shed = 0
+for _ in range(50):
+    try:
+        shed_engine.submit(ScoreRequest({"globalShard": X[0]},
+                                        {"userId": int(eids[0])}))
+    except BackpressureError:
+        shed += 1
+assert shed > 0, "queue_cap=1 under a 50-request burst must shed"
+shed_engine.close()
+engine.close()
+print(f"   {n}/{n} scores bit-exact vs batch driver, retraces=0, "
+      f"shed={shed}/50 OK")
+EOF
+    rm -rf "$tmp"
+}
+
 run_install() {
     echo "== packaging: editable install + console entry points =="
     tmp="$(mktemp -d)"
@@ -214,7 +341,7 @@ run_install() {
     # Entry points must resolve and print usage without touching a backend.
     for cmd in photon-tpu-game-training photon-tpu-game-scoring \
                photon-tpu-train-glm photon-tpu-feature-indexing \
-               photon-tpu-name-and-term-bags; do
+               photon-tpu-name-and-term-bags photon-tpu-game-serving; do
         PYTHONPATH="$parent_site" "$tmp/venv/bin/$cmd" --help > /dev/null
         echo "   $cmd --help OK"
     done
@@ -228,8 +355,9 @@ case "$stage" in
     dryrun) run_dryrun ;;
     telemetry) run_telemetry ;;
     active-set) run_active_set ;;
+    serve) run_serve ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_serve; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

@@ -25,7 +25,11 @@ from photon_tpu.data.index_map import EntityIndex, IndexMap
 from photon_tpu.estimators.game_transformer import GameTransformer
 from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
 from photon_tpu.io.data_reader import read_merged
-from photon_tpu.io.model_io import METADATA_FILE, load_game_model
+from photon_tpu.io.model_io import (
+    load_game_model,
+    model_re_types,
+    read_model_metadata,
+)
 from photon_tpu.io.scores import save_scores
 
 
@@ -70,51 +74,6 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _pad_features(v, pad: int):
-    from photon_tpu.data.batch import SparseFeatures
-
-    if isinstance(v, SparseFeatures):
-        # Rows: zero-valued padding pointing at index 0 contributes nothing.
-        # Columns: the per-chunk nnz width varies with the densest row seen,
-        # so bucket it to the next power of two — otherwise every distinct
-        # width retraces the jitted scorer (one XLA compile per chunk).
-        k = v.indices.shape[1]
-        k_pad = 1 << max(0, (k - 1)).bit_length()
-        if pad == 0 and k_pad == k:
-            return v  # already bucketed: no eager device copies
-        return SparseFeatures(
-            jnp.pad(v.indices, ((0, pad), (0, k_pad - k))),
-            jnp.pad(v.values, ((0, pad), (0, k_pad - k))),
-            v.dim,
-        )
-    return v if pad == 0 else jnp.pad(v, ((0, pad), (0, 0)))
-
-
-def _pad_game_batch(b, target_n: int):
-    """Pad a GameBatch to ``target_n`` rows with weight-0 samples and -1
-    entity ids (scored as zero and dropped by the caller)."""
-    from photon_tpu.data.game_data import GameBatch
-
-    pad = max(target_n - b.n, 0)
-    # pad == 0 still goes through _pad_features: the power-of-two nnz-width
-    # bucketing must apply to EVERY chunk, or a chunk landing exactly on a
-    # chunk_rows multiple keeps its raw width and retraces the jitted
-    # scorer per distinct width (ADVICE r4). Row arrays pass through
-    # untouched in that case (no no-op pads on the streaming hot path).
-    padf = (lambda a: a) if pad == 0 else (
-        lambda a: jnp.pad(a, (0, pad)))  # noqa: E731
-    pad_eid = (lambda v: v) if pad == 0 else (
-        lambda v: jnp.pad(v, (0, pad), constant_values=-1))  # noqa: E731
-    return GameBatch(
-        label=padf(b.label),
-        offset=padf(b.offset),
-        weight=padf(b.weight),  # zeros: padding rows carry no weight
-        features={k: _pad_features(v, pad) for k, v in b.features.items()},
-        entity_ids={k: pad_eid(v) for k, v in b.entity_ids.items()},
-        uid=None if b.uid is None else padf(b.uid),
-    )
-
-
 def run(args) -> Dict:
     setup_logging(args.verbose)
     if getattr(args, "re_active_set", False):
@@ -153,11 +112,7 @@ def run(args) -> Dict:
             os.path.join(artifacts, f"index-map-{shard}.json")
         )
     entity_indexes: Dict[str, EntityIndex] = {}
-    with open(os.path.join(args.model_input_dir, METADATA_FILE)) as f:
-        meta = json.load(f)
-    re_types = [
-        info["reType"] for info in meta["coordinates"].values() if info["type"] == "random"
-    ]
+    re_types = model_re_types(read_model_metadata(args.model_input_dir))
     for re_type in re_types:
         path = os.path.join(artifacts, f"entity-index-{re_type}.json")
         if os.path.exists(path):

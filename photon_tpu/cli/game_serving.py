@@ -1,0 +1,237 @@
+"""GAME online-serving driver: stdlib HTTP/JSONL front end over the
+in-process ServingEngine.
+
+TPU-new driver (no reference counterpart — photon-client ends at batch
+scoring): stands up serve/engine.py behind a threaded stdlib HTTP server.
+One OS thread per connection feeds the shared micro-batcher, which is
+exactly the concurrency shape the batcher was built for: many producer
+threads, one flusher, one jitted scorer.
+
+Endpoints (JSON unless noted):
+
+- ``POST /v1/score`` — one request: ``{"features": {shard: [f0..fd] |
+  {key: value}}, "entityIds": {reType: id}, "offset": 0.0}`` →
+  ``{"score": s, "modelVersion": v}``. 429 on shed, 504 on deadline.
+- ``POST /v1/score-batch`` — JSONL body, one request per line → JSONL
+  response, one ``{"score": s}`` (or ``{"error": ...}``) per line, order
+  preserved.
+- ``POST /v1/reload`` — ``{"modelDir": path}``: zero-downtime swap; old
+  model serves until the new one is warmed.
+- ``GET /healthz`` — engine stats (queue depth, store residency, trace
+  counts, model version).
+
+Shutdown (SIGTERM/SIGINT) drains the queue and, with ``--telemetry-out``,
+writes the unified run report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from photon_tpu.cli.common import setup_logging
+from photon_tpu.serve.batcher import BackpressureError, DeadlineExceededError
+from photon_tpu.serve.engine import ServeConfig, ScoreRequest, load_engine
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("game-serving")
+    p.add_argument("--model-input-dir", required=True)
+    p.add_argument("--model-artifacts-dir", default=None,
+                   help="dir holding index-map-*.json / entity-index-*.json "
+                        "(defaults to the parent of the model dir)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8712,
+                   help="0 picks an ephemeral port (printed on startup)")
+    p.add_argument("--max-batch-size", type=int, default=64,
+                   help="micro-batch row cap; rounded UP onto the bucket_dim "
+                        "shape grid so warm-up covers every dispatch shape")
+    p.add_argument("--max-delay-ms", type=float, default=2.0,
+                   help="max time the oldest queued request waits for the "
+                        "batch to fill before flushing anyway")
+    p.add_argument("--queue-cap", type=int, default=1024,
+                   help="admission bound: submits beyond this depth are shed "
+                        "with HTTP 429 (serve_requests_shed_total)")
+    p.add_argument("--hot-bytes-mb", type=float, default=64.0,
+                   help="device-byte budget for cached random-effect tables "
+                        "(hot store; LRU demotion beyond it)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request deadline (queue wait + scoring); "
+                        "expired requests fail 504 without scorer time")
+    p.add_argument("--telemetry-out", default=None,
+                   help="write the unified run report JSONL here on shutdown")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def _request_from_json(obj: dict) -> ScoreRequest:
+    if not isinstance(obj, dict) or "features" not in obj:
+        raise ValueError("request must be a JSON object with 'features'")
+    return ScoreRequest(
+        features=dict(obj["features"]),
+        entity_ids=dict(obj.get("entityIds", {})),
+        offset=float(obj.get("offset", 0.0)),
+        uid=obj.get("uid"),
+    )
+
+
+def make_handler(engine, artifacts_dir):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through logging, not stderr
+            logger.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, payload: bytes, ctype="application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _reply_json(self, code: int, obj) -> None:
+            self._reply(code, (json.dumps(obj) + "\n").encode())
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(length)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply_json(200, engine.stats())
+            else:
+                self._reply_json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            try:
+                if self.path == "/v1/score":
+                    self._score_one()
+                elif self.path == "/v1/score-batch":
+                    self._score_jsonl()
+                elif self.path == "/v1/reload":
+                    self._reload()
+                else:
+                    self._reply_json(404, {"error": f"no route {self.path}"})
+            except BackpressureError as exc:
+                self._reply_json(429, {"error": str(exc)})
+            except DeadlineExceededError as exc:
+                self._reply_json(504, {"error": str(exc)})
+            except (ValueError, KeyError, json.JSONDecodeError) as exc:
+                self._reply_json(400, {"error": str(exc)})
+            except Exception as exc:  # noqa: BLE001 — 500, keep serving
+                logger.exception("request failed")
+                self._reply_json(500, {"error": str(exc)})
+
+        def _score_one(self):
+            req = _request_from_json(json.loads(self._body()))
+            score = engine.submit(req).result()
+            self._reply_json(
+                200, {"score": score, "modelVersion": engine.model_version}
+            )
+
+        def _score_jsonl(self):
+            # Submit every line first (they co-batch), then collect in
+            # order — a serial submit/await loop would defeat micro-batching.
+            futures = []
+            for line in self._body().splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    futures.append(
+                        engine.submit(_request_from_json(json.loads(line)))
+                    )
+                except (BackpressureError, ValueError,
+                        json.JSONDecodeError) as exc:
+                    futures.append(exc)
+            out = []
+            for f in futures:
+                if isinstance(f, Exception):
+                    out.append({"error": str(f)})
+                else:
+                    try:
+                        out.append({"score": f.result()})
+                    except Exception as exc:  # noqa: BLE001 — per-line error
+                        out.append({"error": str(exc)})
+            payload = "".join(json.dumps(o) + "\n" for o in out).encode()
+            self._reply(200, payload, ctype="application/jsonl")
+
+        def _reload(self):
+            from photon_tpu.io.model_io import load_game_model
+
+            body = json.loads(self._body()) if self.headers.get(
+                "Content-Length"
+            ) else {}
+            model_dir = body.get("modelDir")
+            if not model_dir:
+                raise ValueError("reload needs {'modelDir': path}")
+            # Index maps / entity indexes are generation-stable artifacts
+            # (the training pipeline reuses them across model refreshes);
+            # only the coefficient tables swap.
+            model = load_game_model(
+                model_dir, engine._index_maps, engine._entity_indexes,
+                to_device=False,
+            )
+            info = engine.reload(model, body.get("modelVersion") or model_dir)
+            self._reply_json(200, info)
+
+    return Handler
+
+
+def run(args):
+    setup_logging(args.verbose)
+    from photon_tpu.obs import begin_run, finalize_run_report
+
+    begin_run()
+    config = ServeConfig(
+        max_batch_size=args.max_batch_size,
+        max_delay_ms=args.max_delay_ms,
+        queue_cap=args.queue_cap,
+        hot_bytes=int(args.hot_bytes_mb * (1 << 20)),
+        default_deadline_ms=args.deadline_ms,
+    )
+    logger.info("loading + warming model from %s", args.model_input_dir)
+    engine = load_engine(
+        args.model_input_dir,
+        artifacts_dir=args.model_artifacts_dir,
+        config=config,
+    )
+    server = ThreadingHTTPServer(
+        (args.host, args.port), make_handler(engine, args.model_artifacts_dir)
+    )
+    server.daemon_threads = True
+    stop = threading.Event()
+
+    def _shutdown(signum, frame):
+        stop.set()
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    print(json.dumps({
+        "serving": True,
+        "host": server.server_address[0],
+        "port": server.server_address[1],
+        "maxBatchSize": engine.max_batch,
+        "modelVersion": engine.model_version,
+    }), flush=True)
+    try:
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        engine.close(drain=True)
+        server.server_close()
+        finalize_run_report("game_serving", path=args.telemetry_out)
+        print(json.dumps({"serving": False, "stats": engine.stats()}))
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Shared shape-bucketed GameBatch padding: ONE grid for every consumer.
+
+Three code paths feed the jitted GAME scorer with padded batches — the
+scoring driver's device-side chunk padding (cli/game_scoring.py), the ingest
+pipeline's host-side h2d padding (io/pipeline.py::_bucket_pad_host), and the
+online serving batcher (serve/batcher.py). Their padding rules MUST agree:
+a row-count or nnz-width computed differently in any one of them lands on a
+different XLA program shape, which is both a retrace (latency cliff) and a
+parity bug (the serve/CI bit-parity checks compare across the paths). This
+module is that single rule set.
+
+Rules (identical to the pre-dedupe copies, pinned by tests):
+
+- rows pad with weight-0 samples and ``entity_idx = -1`` (scored as zero and
+  dropped by callers; -1 rows are remapped/dropped at scatter time);
+- sparse nnz widths bucket UP to the next power of two on EVERY batch, even
+  when the row count already fits — a batch landing exactly on the row
+  target must still bucket its width or each distinct width retraces;
+- uid/label/offset pad with zeros.
+
+The helpers are array-namespace generic: pass ``xp=numpy`` for host-side
+padding (pipeline h2d stage, serving batcher assembly — keeps padding off
+the device and lets ``jax.device_put`` ship one contiguous buffer) or
+``xp=jax.numpy`` for device-resident batches (scoring driver chunks that
+are already on device). Both produce bit-identical batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def bucket_pow2(k: int) -> int:
+    """Next power of two ≥ k (k ≥ 0); the sparse nnz-width grid."""
+    return 1 << max(0, (int(k) - 1)).bit_length()
+
+
+def bucket_grid(max_n: int):
+    """Every row-count bucket an online caller can dispatch on with batches
+    of 1..max_n rows: the ``bucket_dim`` grid values up to and including
+    ``bucket_dim(max_n)``. The serving engine warms exactly this set, so
+    "zero retraces after warm-up" is a closed-world guarantee, not a hope."""
+    from photon_tpu.data.random_effect import bucket_dim
+
+    grid = []
+    n = 1
+    top = bucket_dim(int(max_n))
+    while True:
+        b = bucket_dim(n)
+        grid.append(b)
+        if b >= top:
+            return grid
+        n = b + 1
+
+
+def pad_feature_matrix(v, pad: int, xp=np):
+    """Pad one feature leaf by ``pad`` rows; bucket sparse nnz width to the
+    next power of two regardless of ``pad``. Returns ``v`` unchanged when
+    nothing needs padding (no no-op copies on the streaming hot path)."""
+    from photon_tpu.data.batch import SparseFeatures
+
+    if isinstance(v, SparseFeatures):
+        # Rows: zero-valued padding pointing at index 0 contributes nothing.
+        # Columns: the per-batch nnz width varies with the densest row seen,
+        # so bucket it — otherwise every distinct width retraces the jitted
+        # scorer (one XLA compile per batch).
+        k = v.indices.shape[1]
+        k_pad = bucket_pow2(k)
+        if pad == 0 and k_pad == k:
+            return v  # already bucketed: no eager copies
+        indices = xp.pad(xp.asarray(v.indices), ((0, pad), (0, k_pad - k)))
+        values = xp.pad(xp.asarray(v.values), ((0, pad), (0, k_pad - k)))
+        out = SparseFeatures(indices, values, v.dim)
+        if xp is np and v.csc_order is not None:
+            out = out.with_transpose_plan()  # padding changed the pattern
+        return out
+    return v if pad == 0 else xp.pad(xp.asarray(v), ((0, pad), (0, 0)))
+
+
+def pad_game_batch(b, target_n: int, xp=np):
+    """Pad a GameBatch to ``target_n`` rows (weight-0 samples, -1 entity
+    ids) and bucket every sparse shard's nnz width. Returns ``b`` itself
+    when no array changes — callers use identity to skip downstream work."""
+    from photon_tpu.data.game_data import GameBatch
+
+    pad = max(int(target_n) - b.n, 0)
+    features = {k: pad_feature_matrix(v, pad, xp) for k, v in b.features.items()}
+    if pad == 0:
+        if all(f is v for f, v in zip(features.values(), b.features.values())):
+            return b
+        return dataclasses.replace(b, features=features)
+    padf = lambda a: xp.pad(xp.asarray(a), (0, pad))  # noqa: E731
+    return GameBatch(
+        label=padf(b.label),
+        offset=padf(b.offset),
+        weight=padf(b.weight),  # zeros: padding rows carry no weight
+        features=features,
+        entity_ids={
+            k: xp.pad(xp.asarray(v), (0, pad), constant_values=-1)
+            for k, v in b.entity_ids.items()
+        },
+        uid=None if b.uid is None else padf(b.uid),
+    )

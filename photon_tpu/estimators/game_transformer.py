@@ -11,6 +11,7 @@ import logging
 from typing import Dict, Optional
 
 import jax
+import jax.numpy as jnp
 
 from photon_tpu.data.game_data import GameBatch
 from photon_tpu.evaluation.suite import EvaluationSuite
@@ -38,11 +39,37 @@ class GameTransformer:
 
         self._score = jax.jit(_score)
 
-    def transform(self, batch: GameBatch) -> Array:
-        """Per-sample total scores (model + offsets), jitted."""
-        scores = self._score(self.model, batch)
+    def transform(self, batch: GameBatch, model: Optional[GameModel] = None) -> Array:
+        """Per-sample total scores (model + offsets), jitted.
+
+        ``model`` overrides the init-time model for this call — the serving
+        engine passes its store's current ``scoring_model()`` so hot-table
+        promotions take effect. Same pytree STRUCTURE as ``self.model`` →
+        same compiled program (value-only swap, no retrace)."""
+        scores = self._score(self.model if model is None else model, batch)
         if self.evaluation_suite is not None:
             metrics = self.evaluation_suite.evaluate_scores(scores, batch)
             logger.info("scoring evaluation: %s", metrics)
             self.last_metrics: Optional[Dict[str, float]] = metrics
         return scores
+
+    def warm_up(self, template: GameBatch, row_buckets) -> int:
+        """Compile the scorer for every row-count bucket an online caller
+        will dispatch on, up front — the serving engine's startup step that
+        turns "at most one trace per bucket" into "ZERO traces after
+        warm-up" (compiles happen before traffic, never under a request).
+
+        ``template`` is a 1-row batch with the production feature/entity
+        layout; each bucket size pads it with inert rows (weight 0, entity
+        -1 — data/padding.py) and scores it to completion. Tracing is
+        shape-driven, so the dummy values never matter. Returns the number
+        of fresh traces (== number of previously-unseen bucket shapes)."""
+        import jax
+
+        from photon_tpu.data.padding import pad_game_batch
+
+        before = self.trace_count
+        for n in sorted(set(int(b) for b in row_buckets)):
+            padded = pad_game_batch(template, n, xp=jnp)
+            jax.block_until_ready(self._score(self.model, padded))
+        return self.trace_count - before

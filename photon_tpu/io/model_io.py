@@ -324,29 +324,57 @@ def _coefficient_files(cdir: str) -> list:
     return out
 
 
+def read_model_metadata(model_dir: str) -> dict:
+    """Model metadata with a guaranteed ``coordinates`` table: reads the
+    JSON this repo writes, falling back to the reference-layout directory
+    scan (fixed-effect/ + random-effect/ + id-info) when the table is
+    absent. The scoring and serving drivers both key entity-index loading
+    off this — one reader, not two drifting copies."""
+    meta: dict = {}
+    meta_path = os.path.join(model_dir, METADATA_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    if not meta.get("coordinates"):
+        meta["coordinates"] = _scan_model_dir(model_dir, meta)
+    if not meta["coordinates"]:
+        raise FileNotFoundError(
+            f"no GAME model at {model_dir!r}: neither a metadata coordinate "
+            "table nor fixed-effect/ / random-effect/ directories found"
+        )
+    return meta
+
+
+def model_re_types(meta: dict) -> list:
+    """Random-effect types named by a metadata coordinate table, stable
+    order, deduplicated (two coordinates may share one entity space)."""
+    out = []
+    for info in meta.get("coordinates", {}).values():
+        if info.get("type") == "random" and info["reType"] not in out:
+            out.append(info["reType"])
+    return out
+
+
 def load_game_model(
     model_dir: str,
     index_maps: Dict[str, IndexMap],
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    to_device: bool = True,
 ) -> GameModel:
     """loadGameModelFromHDFS role (ModelProcessingUtils.scala:143+). Entity
     ids are re-interned against the provided EntityIndex (or a fresh one),
     so warm starts align with the new run's interning. Reads both this
     repo's metadata-driven layout and reference-written directories
     (directory scan + id-info, proven against the reference's checked-in
-    GameIntegTest fixtures)."""
+    GameIntegTest fixtures).
+
+    ``to_device=False`` keeps coefficient leaves as host numpy — the
+    serving store's master copy, which gathers cold rows host-side and
+    uploads only the hot working set; shipping the full (E, d) matrix to
+    the device just to pull rows back would defeat its byte budget."""
     entity_indexes = entity_indexes if entity_indexes is not None else {}
-    meta = {}
-    meta_path = os.path.join(model_dir, METADATA_FILE)
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-    coordinates = meta.get("coordinates") or _scan_model_dir(model_dir, meta)
-    if not coordinates:
-        raise FileNotFoundError(
-            f"no GAME model at {model_dir!r}: neither a metadata coordinate "
-            "table nor fixed-effect/ / random-effect/ directories found"
-        )
+    coordinates = read_model_metadata(model_dir)["coordinates"]
+    arr = jnp.asarray if to_device else np.asarray
 
     models: Dict[str, object] = {}
     for cid, info in coordinates.items():
@@ -370,8 +398,8 @@ def load_game_model(
             models[cid] = FixedEffectModel(
                 GeneralizedLinearModel(
                     Coefficients(
-                        jnp.asarray(means),
-                        None if variances is None else jnp.asarray(variances),
+                        arr(means),
+                        None if variances is None else arr(variances),
                     ),
                     task,
                 ),
@@ -404,12 +432,12 @@ def load_game_model(
                         variances_arr = np.zeros((E, dim), np.float32)
                     variances_arr[e] = variances
             models[cid] = RandomEffectModel(
-                jnp.asarray(coefs),
+                arr(coefs),
                 re_type,
                 shard,
                 task,
-                None if variances_arr is None else jnp.asarray(variances_arr),
-                present_entities=jnp.asarray(present),
+                None if variances_arr is None else arr(variances_arr),
+                present_entities=arr(present),
             )
     return GameModel(models)
 

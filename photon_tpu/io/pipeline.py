@@ -288,52 +288,20 @@ def _run_staged(
 
 
 def _bucket_pad_host(chunk: BatchChunk, pad_rows_to: int) -> BatchChunk:
-    """Numpy twin of the scoring driver's device-side padding: rows pad to
-    the next ``pad_rows_to`` multiple with weight-0 samples and -1 entity
-    ids; padded-sparse nnz widths bucket to the next power of two. Applied
-    to EVERY chunk (a chunk landing exactly on the multiple still buckets
-    its nnz width) so the jitted consumer compiles once per bucket shape."""
-    from photon_tpu.data.batch import SparseFeatures
-    from photon_tpu.data.game_data import GameBatch
+    """Numpy-side bucket padding: rows pad to the next ``pad_rows_to``
+    multiple with weight-0 samples and -1 entity ids; padded-sparse nnz
+    widths bucket to the next power of two. Applied to EVERY chunk (a chunk
+    landing exactly on the multiple still buckets its nnz width) so the
+    jitted consumer compiles once per bucket shape. The padding rules live
+    in data/padding.py — shared with the serving batcher, which must land
+    on the SAME program shapes."""
+    from photon_tpu.data.padding import pad_game_batch
 
-    b = chunk.batch
     n = chunk.n
     target = int(np.ceil(n / pad_rows_to) * pad_rows_to) if n else pad_rows_to
-    pad = target - n
-
-    def pad_feat(v):
-        if isinstance(v, SparseFeatures):
-            k = v.indices.shape[1]
-            k_pad = 1 << max(0, (k - 1)).bit_length()
-            if pad == 0 and k_pad == k:
-                return v
-            indices = np.pad(np.asarray(v.indices), ((0, pad), (0, k_pad - k)))
-            values = np.pad(np.asarray(v.values), ((0, pad), (0, k_pad - k)))
-            out = SparseFeatures(indices, values, v.dim)
-            if v.csc_order is not None:  # padding changed the index pattern
-                out = out.with_transpose_plan()
-            return out
-        return v if pad == 0 else np.pad(v, ((0, pad), (0, 0)))
-
-    if pad == 0:
-        features = {k: pad_feat(v) for k, v in b.features.items()}
-        if all(f is v for f, v in zip(features.values(), b.features.values())):
-            return chunk
-        return BatchChunk(
-            dataclasses.replace(b, features=features), n, chunk.index
-        )
-    padf = lambda a: np.pad(a, (0, pad))  # noqa: E731
-    batch = GameBatch(
-        label=padf(b.label),
-        offset=padf(b.offset),
-        weight=padf(b.weight),  # zeros: padding rows carry no weight
-        features={k: pad_feat(v) for k, v in b.features.items()},
-        entity_ids={
-            k: np.pad(v, (0, pad), constant_values=-1)
-            for k, v in b.entity_ids.items()
-        },
-        uid=None if b.uid is None else padf(b.uid),
-    )
+    batch = pad_game_batch(chunk.batch, target, xp=np)
+    if batch is chunk.batch:
+        return chunk
     return BatchChunk(batch, n, chunk.index)
 
 

@@ -30,7 +30,14 @@ class Coefficients:
     def compute_score(self, features: Features) -> Array:
         if isinstance(features, SparseFeatures):
             return features.matvec(self.means)
-        return features @ self.means
+        # Broadcast-multiply + per-row reduce instead of ``features @ means``:
+        # XLA CPU lowers the matvec to DIFFERENT accumulation orders at
+        # different row counts (gemv at n=1, tiled gemm variants above), so
+        # matmul scores are not bit-stable across batch sizes. The per-row
+        # reduce is — which is what lets chunked/streamed/micro-batched
+        # scoring promise atol=0 parity with the slurped batch path
+        # (tests pin this; serving's bucket-padded dispatch relies on it).
+        return jnp.sum(features * self.means, axis=-1)
 
     @staticmethod
     def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":

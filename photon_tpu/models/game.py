@@ -68,6 +68,17 @@ class RandomEffectModel:
     def num_entities(self) -> int:
         return self.coefficients.shape[0]
 
+    def with_coefficients(self, coefficients: Array) -> "RandomEffectModel":
+        """Serving copy: same static metadata, swapped coefficient table.
+        The serving hot store passes an (H, d) device-resident hot table
+        with H ≠ E and SLOT indices in ``batch.entity_ids`` — auxiliary
+        arrays (variances, presence) are dropped so the scoring pytree
+        structure is identical across models and reloads (one jit cache
+        entry, never a retrace on swap)."""
+        return RandomEffectModel(
+            coefficients, self.re_type, self.feature_shard, self.task
+        )
+
     def score(self, batch: GameBatch) -> Array:
         """Gather-by-entity scoring (replaces RandomEffectModel.scala's
         keyBy(REId).join(modelsRDD))."""
@@ -207,3 +218,33 @@ class GameModel:
         new = dict(self.models)
         new[coordinate_id] = model
         return GameModel(new)
+
+    def updated_many(
+        self, replacements: Dict[str, DatumScoringModel]
+    ) -> "GameModel":
+        """One-shot multi-coordinate swap (the serving store replaces every
+        random-effect table atomically)."""
+        new = dict(self.models)
+        new.update(replacements)
+        return GameModel(new)
+
+    def feature_shard_dims(self) -> Dict[str, int]:
+        """Feature dimensionality per shard, from the submodels themselves —
+        what a serving front end needs to assemble request rows without the
+        training dataset in hand. Coordinates sharing a shard agree by
+        construction (they were trained on the same shard matrices)."""
+        dims: Dict[str, int] = {}
+        for sub in self.models.values():
+            if isinstance(sub, FixedEffectModel):
+                d = int(sub.model.coefficients.dim)
+            elif isinstance(sub, RandomEffectModel):
+                d = int(sub.coefficients.shape[1])
+            else:
+                d = int(sub.d_full)
+            prev = dims.setdefault(sub.feature_shard, d)
+            if prev != d:
+                raise ValueError(
+                    f"shard {sub.feature_shard!r} has inconsistent dims "
+                    f"{prev} vs {d} across coordinates"
+                )
+        return dims

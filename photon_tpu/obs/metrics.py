@@ -94,10 +94,20 @@ class Gauge(_Instrument):
 
 
 class Histogram(_Instrument):
-    """Streaming summary (count/sum/min/max) — enough for the report's
-    distribution columns without unbounded per-observation storage."""
+    """Streaming summary (count/sum/min/max) plus a bounded DETERMINISTIC
+    reservoir for percentile estimates (p50/p95/p99 — the latency columns a
+    serving report is useless without).
+
+    The reservoir keeps every observation until it reaches capacity, then
+    halves itself (every 2nd element) and doubles its sampling stride, so it
+    always holds an evenly-strided subsample of the full sequence in
+    arrival order. Deterministic by construction — no RNG — so two runs over
+    the same observation sequence report the same percentiles, and memory is
+    bounded at ``RESERVOIR_CAP`` floats regardless of observation count.
+    Percentiles are exact below the cap and stride-approximate above it."""
 
     kind = "histogram"
+    RESERVOIR_CAP = 4096
 
     def __init__(self, name: str, labels: LabelKey):
         super().__init__(name, labels)
@@ -105,6 +115,9 @@ class Histogram(_Instrument):
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self._sample: List[float] = []
+        self._stride = 1
+        self._since_kept = 0
 
     def observe(self, value: float) -> None:
         v = float(value)
@@ -113,13 +126,38 @@ class Histogram(_Instrument):
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            self._since_kept += 1
+            if self._since_kept >= self._stride:
+                self._since_kept = 0
+                self._sample.append(v)
+                if len(self._sample) >= self.RESERVOIR_CAP:
+                    self._sample = self._sample[::2]
+                    self._stride *= 2
 
     @property
     def mean(self) -> Optional[float]:
         with self._lock:
             return self.sum / self.count if self.count else None
 
+    @staticmethod
+    def _quantile(ordered: List[float], q: float) -> float:
+        """Linear interpolation between closest ranks (numpy's default)."""
+        pos = q * (len(ordered) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(ordered) - 1)
+        return ordered[lo] + (ordered[hi] - ordered[lo]) * (pos - lo)
+
+    def percentiles(self, qs=(0.5, 0.95, 0.99)) -> Dict[str, Optional[float]]:
+        with self._lock:
+            ordered = sorted(self._sample)
+        if not ordered:
+            return {f"p{round(q * 100)}": None for q in qs}
+        return {
+            f"p{round(q * 100)}": self._quantile(ordered, q) for q in qs
+        }
+
     def as_dict(self) -> dict:
+        pcts = self.percentiles()
         with self._lock:
             stats = dict(
                 count=self.count,
@@ -127,6 +165,7 @@ class Histogram(_Instrument):
                 min=self.min,
                 max=self.max,
                 mean=self.sum / self.count if self.count else None,
+                **pcts,
             )
         return dict(record="metric", metric=self.name, type=self.kind,
                     labels=self.label_dict(), value=None, stats=stats)

@@ -28,7 +28,12 @@ import sys
 import threading
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 1
+# v2 (2026-08): histogram ``stats`` gained p50/p95/p99 keys (bounded
+# deterministic reservoir, obs/metrics.py). Backward compatible for readers:
+# ``stats`` was already typed as an open dict, no field was removed or
+# renamed — v1 readers keep parsing v2 artifacts; only readers that REQUIRE
+# percentiles need to check schema_version >= 2.
+SCHEMA_VERSION = 2
 
 _NONE = type(None)
 

@@ -1,0 +1,22 @@
+"""Online GAME serving: micro-batched scoring, hot/cold entity residency,
+zero-downtime reload. See serve/engine.py for the composition."""
+
+from photon_tpu.serve.batcher import (
+    BackpressureError,
+    DeadlineExceededError,
+    MicroBatcher,
+    ScoreRequest,
+)
+from photon_tpu.serve.engine import ServeConfig, ServingEngine, load_engine
+from photon_tpu.serve.store import HotColdEntityStore
+
+__all__ = [
+    "BackpressureError",
+    "DeadlineExceededError",
+    "HotColdEntityStore",
+    "MicroBatcher",
+    "ScoreRequest",
+    "ServeConfig",
+    "ServingEngine",
+    "load_engine",
+]

@@ -1,0 +1,339 @@
+"""Online GAME serving engine: micro-batched scoring with zero-downtime
+model reload.
+
+Composition of the two sibling modules plus the batch stack's own scorer:
+a :class:`~photon_tpu.serve.batcher.MicroBatcher` admits and batches
+requests, a :class:`~photon_tpu.serve.store.HotColdEntityStore` resolves
+entity ids to device-resident coefficient rows, and the SAME jitted
+``GameTransformer`` program the batch scoring driver runs produces the
+scores — which is what makes the CI bit-parity check (serve vs batch
+driver, atol=0) meaningful rather than aspirational.
+
+The no-retrace contract, end to end:
+
+1. startup ``warm_up`` scores an inert template batch at EVERY row bucket in
+   ``bucket_grid(max_batch_size)`` and compiles every hot-store upload
+   scatter, so all program shapes exist before traffic;
+2. every live batch pads up the same grid (``pad_game_batch``), so it lands
+   on a warmed shape;
+3. the per-batch scoring model swaps table VALUES only (identical pytree
+   structure via ``with_coefficients``), so promotions and reloads reuse the
+   compiled program.
+
+``retraces_since_warmup`` exposes the in-trace counter delta — the
+observable the serve CI stage and ``bench.py --serve-ab`` assert to be 0.
+
+Reload is build-then-swap: the incoming model gets its OWN store +
+transformer + warm-up while the old state keeps serving; the swap happens
+under the engine's scoring lock, so in-flight batches drain on the old
+state and the next batch scores on the new one. No request ever observes a
+half-loaded model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.index_map import EntityIndex, IndexMap
+from photon_tpu.data.padding import bucket_grid, pad_game_batch
+from photon_tpu.data.random_effect import bucket_dim
+from photon_tpu.estimators.game_transformer import GameTransformer
+from photon_tpu.models.game import GameModel
+from photon_tpu.obs.metrics import registry
+from photon_tpu.obs.trace import tracer
+from photon_tpu.serve.batcher import MicroBatcher, ScoreRequest
+from photon_tpu.serve.store import HotColdEntityStore
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    max_batch_size: int = 64  # rounded UP onto the bucket_dim grid
+    max_delay_ms: float = 2.0  # oldest request's max queue dwell
+    queue_cap: int = 1024  # admission bound; beyond it submits shed
+    hot_bytes: int = 64 << 20  # device budget for cached RE tables
+    default_deadline_ms: Optional[float] = None  # per-request unless given
+
+
+@dataclasses.dataclass
+class _State:
+    """Everything that swaps atomically on reload."""
+
+    store: HotColdEntityStore
+    transformer: GameTransformer
+    model_version: str
+    warm_traces: int  # trace_count right after warm-up
+
+
+class ServingEngine:
+    """In-process serving core; cli/game_serving.py adds the HTTP front end.
+
+    ``model`` must be the HOST-side master (``load_game_model(...,
+    to_device=False)``) — the store decides what becomes device-resident.
+    """
+
+    def __init__(
+        self,
+        model: GameModel,
+        entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+        index_maps: Optional[Dict[str, IndexMap]] = None,
+        config: Optional[ServeConfig] = None,
+        model_version: str = "0",
+    ):
+        self.config = config or ServeConfig()
+        self.max_batch = bucket_dim(int(self.config.max_batch_size))
+        self._entity_indexes = dict(entity_indexes or {})
+        self._index_maps = dict(index_maps or {})
+        self._shard_dims = model.feature_shard_dims()
+        self._intercept_col = {
+            shard: (
+                self._index_maps[shard].get_index(IndexMap.INTERCEPT)
+                if shard in self._index_maps
+                else -1
+            )
+            for shard in self._shard_dims
+        }
+        self._lock = threading.RLock()
+        self._reloads = 0
+        self._state = self._build_state(model, model_version)
+        self.batcher = MicroBatcher(
+            self._score_batch,
+            max_batch_size=self.max_batch,
+            max_delay_s=self.config.max_delay_ms / 1000.0,
+            queue_cap=self.config.queue_cap,
+        )
+
+    # -- state construction (startup and reload share it) -------------------
+
+    def _build_state(self, model: GameModel, version: str) -> _State:
+        """Store + transformer + FULL warm-up for one model generation.
+        Runs entirely off the scoring lock so reloads never stall traffic."""
+        with tracer().span("serve/warm_up"):
+            store = HotColdEntityStore(
+                model,
+                self._entity_indexes,
+                hot_bytes=self.config.hot_bytes,
+                # Floor: one batch's unique entities always fit resident.
+                min_hot_rows=self.max_batch,
+            )
+            store.warm_uploads(self.max_batch)
+            transformer = GameTransformer(store.scoring_model())
+            template = self._template_batch(store)
+            traces = transformer.warm_up(template, bucket_grid(self.max_batch))
+            registry().gauge("serve_warmup_traces").set(traces)
+        return _State(store, transformer, version, transformer.trace_count)
+
+    def _template_batch(self, store: HotColdEntityStore) -> GameBatch:
+        """1-row inert batch with the production layout: dense zero features
+        per shard, entity -1 (cold start) per RE type. Tracing is
+        shape-driven, so values are irrelevant."""
+        import jax.numpy as jnp
+
+        return GameBatch(
+            label=jnp.zeros(1, jnp.float32),
+            offset=jnp.zeros(1, jnp.float32),
+            weight=jnp.ones(1, jnp.float32),
+            features={
+                s: jnp.zeros((1, d), jnp.float32)
+                for s, d in self._shard_dims.items()
+            },
+            entity_ids={
+                rt: jnp.full(1, -1, jnp.int32)
+                for rt in store.entity_re_types
+            },
+        )
+
+    # -- request assembly ---------------------------------------------------
+
+    def _dense_row(self, shard: str, value) -> np.ndarray:
+        """One request's feature payload → dense (d,) float32. Serving
+        always densifies: per-row dot products over a fixed d are row-count
+        independent, which is what buys bit-parity with the batch driver."""
+        d = self._shard_dims[shard]
+        row = np.zeros(d, np.float32)
+        icpt = self._intercept_col.get(shard, -1)
+        if icpt >= 0:
+            row[icpt] = 1.0
+        if value is None:
+            return row
+        if isinstance(value, dict):
+            imap = self._index_maps.get(shard)
+            for k, v in value.items():
+                if isinstance(k, str):
+                    if imap is None:
+                        raise ValueError(
+                            f"string feature keys need an index map for "
+                            f"shard {shard!r}"
+                        )
+                    j = imap.get_index(k)
+                else:
+                    j = int(k)
+                if 0 <= j < d:
+                    row[j] = v  # unknown features drop (batch-path parity)
+            return row
+        if (
+            isinstance(value, (tuple, list))
+            and len(value) == 2
+            and not np.isscalar(value[0])
+            and np.ndim(value[0]) == 1
+            and np.ndim(value[1]) == 1
+            and len(value[0]) == len(value[1])
+            and len(value[0]) != d
+        ):
+            idx = np.asarray(value[0], np.int64)
+            vals = np.asarray(value[1], np.float32)
+            ok = (idx >= 0) & (idx < d)
+            row[idx[ok]] = vals[ok]
+            return row
+        # Dense vectors are taken verbatim — the caller owns every column,
+        # intercept included (that's what the parity harness feeds).
+        arr = np.asarray(value, np.float32)
+        if arr.shape != (d,):
+            raise ValueError(
+                f"shard {shard!r} expects a ({d},) vector, got {arr.shape}"
+            )
+        return arr
+
+    def _assemble(
+        self, requests: List[ScoreRequest], store: HotColdEntityStore
+    ) -> GameBatch:
+        n = len(requests)
+        features = {}
+        for shard in self._shard_dims:
+            features[shard] = np.stack(
+                [self._dense_row(shard, r.features.get(shard)) for r in requests]
+            )
+        entity_ids = {}
+        for rt in store.entity_re_types:
+            keys = [r.entity_ids.get(rt, -1) for r in requests]
+            entity_ids[rt] = store.resolve(rt, keys)
+        return GameBatch(
+            label=np.zeros(n, np.float32),
+            offset=np.asarray([r.offset for r in requests], np.float32),
+            weight=np.ones(n, np.float32),
+            features=features,
+            entity_ids=entity_ids,
+        )
+
+    # -- the batcher's score_fn --------------------------------------------
+
+    def _score_batch(self, requests: List[ScoreRequest]) -> Sequence[float]:
+        import jax
+
+        with self._lock:  # vs reload swap; store.resolve is single-writer
+            state = self._state
+            n = len(requests)
+            with tracer().span("score"):
+                batch = self._assemble(requests, state.store)
+                batch = pad_game_batch(batch, bucket_dim(n), xp=np)
+                dev = jax.device_put(batch)
+                scores = state.transformer.transform(
+                    dev, model=state.store.scoring_model()
+                )
+                return np.asarray(scores)[:n]
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(
+        self, request: ScoreRequest, deadline_s: Optional[float] = None
+    ):
+        if deadline_s is None and self.config.default_deadline_ms is not None:
+            deadline_s = self.config.default_deadline_ms / 1000.0
+        return self.batcher.submit(request, deadline_s)
+
+    def score(
+        self,
+        features: Dict[str, object],
+        entity_ids: Optional[Dict[str, object]] = None,
+        offset: float = 0.0,
+        deadline_s: Optional[float] = None,
+    ) -> float:
+        """Synchronous convenience wrapper: one request, blocking."""
+        return self.submit(
+            ScoreRequest(features, dict(entity_ids or {}), offset),
+            deadline_s,
+        ).result()
+
+    @property
+    def model_version(self) -> str:
+        return self._state.model_version
+
+    @property
+    def retraces_since_warmup(self) -> int:
+        """0 is the contract; anything else means a live batch compiled."""
+        state = self._state
+        return state.transformer.trace_count - state.warm_traces
+
+    def reload(self, model: GameModel, model_version: Optional[str] = None) -> Dict:
+        """Zero-downtime swap to ``model`` (host-side master). Builds and
+        warms the new generation OFF the scoring lock — the old state keeps
+        serving — then swaps under it, which also drains the in-flight
+        batch. Returns the new generation's stats."""
+        self._reloads += 1
+        version = model_version or f"reload-{self._reloads}"
+        new_state = self._build_state(model, version)  # old state serving
+        with tracer().span("serve/reload_swap"):
+            with self._lock:
+                self._state = new_state
+        registry().counter("serve_model_reloads_total").inc()
+        return dict(model_version=version, store=new_state.store.stats())
+
+    def stats(self) -> Dict:
+        state = self._state
+        return dict(
+            model_version=state.model_version,
+            queue_depth=self.batcher.queue_depth,
+            max_batch_size=self.max_batch,
+            trace_count=state.transformer.trace_count,
+            retraces_since_warmup=self.retraces_since_warmup,
+            store=state.store.stats(),
+        )
+
+    def close(self, drain: bool = True) -> None:
+        self.batcher.close(drain=drain)
+
+
+def load_engine(
+    model_dir: str,
+    artifacts_dir: Optional[str] = None,
+    config: Optional[ServeConfig] = None,
+    model_version: Optional[str] = None,
+) -> ServingEngine:
+    """Build an engine from a trained model directory the way the batch
+    scoring driver would: index maps + entity indexes from the artifacts
+    dir (default: the model dir's parent = the training output dir), model
+    loaded HOST-side (the store owns device residency)."""
+    from photon_tpu.io.model_io import (
+        load_game_model,
+        model_re_types,
+        read_model_metadata,
+    )
+
+    artifacts = artifacts_dir or os.path.dirname(model_dir.rstrip("/"))
+    meta = read_model_metadata(model_dir)
+    index_maps: Dict[str, IndexMap] = {}
+    for coord in meta.get("coordinates", {}).values():
+        shard = coord.get("featureShard")
+        path = os.path.join(artifacts, f"index-map-{shard}.json")
+        if shard and shard not in index_maps and os.path.exists(path):
+            index_maps[shard] = IndexMap.load(path)
+    entity_indexes: Dict[str, EntityIndex] = {}
+    for re_type in model_re_types(meta):
+        path = os.path.join(artifacts, f"entity-index-{re_type}.json")
+        if os.path.exists(path):
+            entity_indexes[re_type] = EntityIndex.load(path)
+    model = load_game_model(
+        model_dir, index_maps, entity_indexes, to_device=False
+    )
+    return ServingEngine(
+        model,
+        entity_indexes=entity_indexes,
+        index_maps=index_maps,
+        config=config,
+        model_version=model_version or model_dir.rstrip("/"),
+    )

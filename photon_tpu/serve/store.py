@@ -1,0 +1,354 @@
+"""Hot/cold entity coefficient store for online GAME scoring.
+
+Photon ML's GAME shape — one global model plus millions of per-entity
+models — makes serving a lookup-then-score problem. The lookup side is this
+module: per-entity coefficient rows live COLD on the host (the numpy master
+copy ``load_game_model(to_device=False)`` returns) and HOT in a
+device-resident table under an explicit byte budget, with LRU demotion.
+Request entity ids resolve to hot-table SLOTS; misses gather their rows from
+the host master and upload them in one shape-bucketed scatter per batch, so
+the device never holds more than the working set and the jitted scorer's
+program shapes never change.
+
+Slot discipline: coordinates sharing a random-effect type share ONE slot
+assignment (their tables are indexed by the same ``entity_ids`` array in the
+batch), so the LRU is per RE type with one device table per coordinate.
+A type whose full table fits the budget is PINNED — full device residency,
+entity ids pass through as slots, the miss path never runs. Unknown/cold
+entities resolve to slot -1 and score 0, exactly the batch path's
+cold-start semantics.
+
+Zero-downtime reload builds a NEW store (and scorer) for the incoming model
+while the old one keeps serving, then swaps atomically — see
+serve/engine.py. The store itself is single-writer: the engine serializes
+``resolve``/upload under its batch lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.data.random_effect import bucket_dim
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    ProjectedRandomEffectModel,
+    RandomEffectModel,
+)
+from photon_tpu.obs.metrics import registry
+
+_scatter_rows = None
+
+
+def _scatter(table, idx, rows):
+    """Jitted hot-table row upload. ``idx`` is padded to a bucketed length
+    with the out-of-range value H (``mode="drop"`` discards it — NB negative
+    indices WRAP in XLA scatters, so high-out-of-range is the safe filler).
+    One executable per (H, d, m_bucket) shape; ``warm_uploads`` compiles
+    them before traffic."""
+    global _scatter_rows
+    if _scatter_rows is None:
+        import jax
+
+        # NOT donated: the previous table buffer may still be referenced by
+        # a scoring-model pytree a caller holds (e.g. the transformer's
+        # init-time model) — donating it would invalidate those references.
+        _scatter_rows = jax.jit(lambda t, i, r: t.at[i].set(r, mode="drop"))
+    return _scatter_rows(table, idx, rows)
+
+
+@dataclasses.dataclass
+class _ReGroup:
+    """All random-effect coordinates sharing one RE type: one slot LRU,
+    one device table per coordinate."""
+
+    re_type: str
+    coord_ids: List[str]
+    host_coefs: Dict[str, np.ndarray]  # cid -> (E, d) float32 master copy
+    num_entities: int
+    capacity: int  # H: hot rows (== num_entities when pinned)
+    pinned: bool
+    tables: Dict[str, object] = dataclasses.field(default_factory=dict)
+    slot_of: "OrderedDict[int, int]" = dataclasses.field(
+        default_factory=OrderedDict
+    )
+    free_slots: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def row_bytes(self) -> int:
+        return sum(4 * c.shape[1] for c in self.host_coefs.values())
+
+
+class HotColdEntityStore:
+    """Entity-model residency manager + scoring-model factory.
+
+    ``hot_bytes`` bounds the device bytes of CACHED random-effect tables
+    (split across RE types proportionally to their full size). The floor is
+    ``min_hot_rows`` per type — the engine passes its max batch size, which
+    guarantees every unique entity of one batch fits resident simultaneously
+    (the resolve path never has to evict a slot the current batch needs).
+    """
+
+    def __init__(
+        self,
+        model: GameModel,
+        entity_indexes: Optional[Dict] = None,
+        hot_bytes: int = 64 << 20,
+        min_hot_rows: int = 64,
+    ):
+        import jax
+
+        self._entity_indexes = dict(entity_indexes or {})
+        self._groups: Dict[str, _ReGroup] = {}
+        self._re_subs: Dict[str, RandomEffectModel] = {}
+        # RE types whose tables serve fully device-resident OUTSIDE the LRU
+        # (projected models): entity ids pass straight through as indices.
+        self._passthrough: Dict[str, int] = {}
+        base: Dict[str, object] = {}
+
+        by_type: Dict[str, List] = {}
+        for cid, sub in model.models.items():
+            if isinstance(sub, RandomEffectModel):
+                by_type.setdefault(sub.re_type, []).append((cid, sub))
+            else:
+                # Fixed effects and projected RE models serve device-resident
+                # as-is (projected tables are already the compact subspace
+                # form — their hot/cold split is an open item).
+                if isinstance(sub, ProjectedRandomEffectModel):
+                    self._passthrough[sub.re_type] = max(
+                        self._passthrough.get(sub.re_type, 0),
+                        int(sub.num_entities),
+                    )
+                base[cid] = jax.device_put(sub)
+
+        budget_total = sum(
+            sum(4 * np.asarray(s.coefficients).shape[1] for _, s in subs)
+            * max(np.asarray(subs[0][1].coefficients).shape[0], 1)
+            for subs in by_type.values()
+        )
+        for re_type, subs in by_type.items():
+            host = {
+                cid: np.ascontiguousarray(
+                    np.asarray(s.coefficients, dtype=np.float32)
+                )
+                for cid, s in subs
+            }
+            E = {c.shape[0] for c in host.values()}
+            if len(E) != 1:
+                raise ValueError(
+                    f"RE type {re_type!r}: coordinates disagree on entity "
+                    f"count {sorted(E)}"
+                )
+            E = E.pop()
+            row_bytes = sum(4 * c.shape[1] for c in host.values())
+            full_bytes = row_bytes * max(E, 1)
+            share = (
+                int(hot_bytes * full_bytes / budget_total)
+                if budget_total
+                else hot_bytes
+            )
+            cap = max(int(min_hot_rows), share // max(row_bytes, 1))
+            pinned = cap >= E
+            cap = min(cap, E) if pinned else cap
+            group = _ReGroup(
+                re_type=re_type,
+                coord_ids=[cid for cid, _ in subs],
+                host_coefs=host,
+                num_entities=E,
+                capacity=max(cap, 1),
+                pinned=pinned,
+            )
+            if pinned:
+                group.tables = {
+                    cid: jax.device_put(host[cid]) for cid in group.coord_ids
+                }
+            else:
+                group.tables = {
+                    cid: jax.device_put(
+                        np.zeros(
+                            (group.capacity, host[cid].shape[1]), np.float32
+                        )
+                    )
+                    for cid in group.coord_ids
+                }
+                group.free_slots = list(range(group.capacity - 1, -1, -1))
+            self._groups[re_type] = group
+            for cid, s in subs:
+                self._re_subs[cid] = s
+            reg = registry()
+            reg.gauge("serve_store_hot_rows", re_type=re_type).set(
+                group.capacity
+            )
+            reg.gauge("serve_store_hot_bytes", re_type=re_type).set(
+                group.capacity * row_bytes
+            )
+            reg.gauge("serve_store_pinned", re_type=re_type).set(int(pinned))
+        self._base = base
+
+    # -- residency ---------------------------------------------------------
+
+    @property
+    def re_types(self) -> List[str]:
+        """RE types under hot/cold management (table-swapped at scoring)."""
+        return list(self._groups)
+
+    @property
+    def entity_re_types(self) -> List[str]:
+        """Every RE type a batch must carry entity ids for — managed groups
+        plus passthrough (projected) types."""
+        return list(self._groups) + [
+            t for t in self._passthrough if t not in self._groups
+        ]
+
+    def group(self, re_type: str) -> Optional[_ReGroup]:
+        return self._groups.get(re_type)
+
+    def _intern(self, re_type: str, key, num_entities: int) -> int:
+        """Request entity key → dense [0, E) index; -1 when unknown."""
+        if isinstance(key, str):
+            eidx = self._entity_indexes.get(re_type)
+            i = eidx.lookup(key) if eidx is not None else -1
+        else:
+            i = int(key)
+        return i if 0 <= i < num_entities else -1
+
+    def resolve(self, re_type: str, keys: Sequence) -> np.ndarray:
+        """Entity keys (interned ints or raw string ids) → hot-table slots,
+        promoting misses from the host master. -1 rows (cold start) pass
+        through and score 0. Single-writer: the engine's batch lock
+        serializes calls."""
+        group = self._groups.get(re_type)
+        if group is None:
+            E = self._passthrough.get(re_type)
+            if E is None:
+                return np.full(len(keys), -1, np.int32)
+            return np.fromiter(
+                (self._intern(re_type, k, E) for k in keys),
+                dtype=np.int32,
+                count=len(keys),
+            )
+        ids = np.fromiter(
+            (self._intern(re_type, k, group.num_entities) for k in keys),
+            dtype=np.int64,
+            count=len(keys),
+        )
+        if group.pinned:
+            return ids.astype(np.int32)
+
+        reg = registry()
+        slots = np.empty(len(ids), np.int32)
+        in_use = set()
+        misses: List[int] = []  # entity ids needing upload, slot assigned
+        hits = 0
+        for j, e in enumerate(ids):
+            e = int(e)
+            if e < 0:
+                slots[j] = -1
+                continue
+            slot = group.slot_of.get(e)
+            if slot is not None:
+                group.slot_of.move_to_end(e)
+                if e not in in_use and e not in misses:
+                    hits += 1
+            else:
+                slot = self._claim_slot(group, in_use)
+                group.slot_of[e] = slot
+                misses.append(e)
+            in_use.add(e)
+            slots[j] = slot
+        if hits:
+            reg.counter("serve_store_hits_total", re_type=re_type).inc(hits)
+        if misses:
+            reg.counter("serve_store_misses_total", re_type=re_type).inc(
+                len(misses)
+            )
+            self._upload(group, misses)
+        return slots
+
+    def _claim_slot(self, group: _ReGroup, in_use: set) -> int:
+        if group.free_slots:
+            return group.free_slots.pop()
+        # Demote the least-recently-used entity that is NOT part of the
+        # current batch. capacity ≥ max batch size guarantees a victim.
+        for victim in group.slot_of:
+            if victim not in in_use:
+                slot = group.slot_of.pop(victim)
+                registry().counter(
+                    "serve_store_demotions_total", re_type=group.re_type
+                ).inc()
+                return slot
+        raise RuntimeError(
+            f"hot store for {group.re_type!r} exhausted: batch has more "
+            f"unique entities than capacity {group.capacity}"
+        )
+
+    def _upload(self, group: _ReGroup, entities: List[int]) -> None:
+        """One bucketed scatter per coordinate: miss count pads up the
+        shape grid, filler indices land out of range and drop."""
+        m = len(entities)
+        m_b = bucket_dim(m)
+        idx = np.full(m_b, group.capacity, np.int32)
+        idx[:m] = [group.slot_of[e] for e in entities]
+        ent = np.asarray(entities, np.int64)
+        for cid in group.coord_ids:
+            host = group.host_coefs[cid]
+            rows = np.zeros((m_b, host.shape[1]), np.float32)
+            rows[:m] = host[ent]
+            group.tables[cid] = _scatter(group.tables[cid], idx, rows)
+
+    def warm_uploads(self, max_batch: int) -> None:
+        """Compile the upload scatters for every miss-count bucket ≤
+        ``max_batch`` (no-op rows: every filler index drops), so promotion
+        never compiles under a request."""
+        import jax
+
+        for group in self._groups.values():
+            if group.pinned:
+                continue
+            m = 1
+            while True:
+                m_b = bucket_dim(m)
+                idx = np.full(m_b, group.capacity, np.int32)
+                for cid in group.coord_ids:
+                    d = group.host_coefs[cid].shape[1]
+                    group.tables[cid] = _scatter(
+                        group.tables[cid], idx, np.zeros((m_b, d), np.float32)
+                    )
+                if m_b >= bucket_dim(max_batch):
+                    break
+                m = m_b + 1
+            for cid in group.coord_ids:
+                jax.block_until_ready(group.tables[cid])
+
+    # -- scoring model -----------------------------------------------------
+
+    def scoring_model(self) -> GameModel:
+        """The model the jitted scorer runs: device submodels, with every
+        cached random-effect table swapped in (slot-indexed). Pytree
+        structure is identical call to call and reload to reload — the
+        tables change VALUE only, so the scorer never retraces."""
+        models = dict(self._base)
+        for re_type, group in self._groups.items():
+            for cid in group.coord_ids:
+                models[cid] = self._re_subs[cid].with_coefficients(
+                    group.tables[cid]
+                )
+        return GameModel(models)
+
+    def stats(self) -> Dict[str, dict]:
+        out = {}
+        for re_type, group in self._groups.items():
+            out[re_type] = dict(
+                entities=group.num_entities,
+                hot_capacity=group.capacity,
+                hot_resident=(
+                    group.num_entities if group.pinned else len(group.slot_of)
+                ),
+                pinned=group.pinned,
+                hot_bytes=group.capacity * group.row_bytes,
+            )
+        return out

@@ -230,23 +230,55 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
             ),
             **{f"leaf_{i}": a for i, a in enumerate(arrays)},
         )
+        # Durability before visibility: without the fsync, a machine crash
+        # (not just process preemption) can publish a rename whose DATA
+        # blocks never hit disk — a torn file at the final name.
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic publish — no torn checkpoints on preemption
     latest_tmp = os.path.join(directory, _LATEST + ".tmp")
     with open(latest_tmp, "w") as f:
         f.write(str(step))
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(latest_tmp, os.path.join(directory, _LATEST))
     return path
 
 
+def _scan_steps(directory: str) -> list:
+    """Step numbers of every self-contained step_<N>.npz present."""
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_") and name.endswith(".npz"):
+            try:
+                steps.append(int(name[len("step_"):-len(".npz")]))
+            except ValueError:
+                continue
+    return sorted(steps)
+
+
 def latest_step(directory: str) -> Optional[int]:
+    """Newest resumable step. The LATEST pointer is an optimization, not
+    the source of truth: when it is missing, torn (garbage content), or
+    names a step file that does not exist, fall back to scanning the
+    self-contained ``step_<N>.npz`` files — a half-written pointer must
+    never strand an otherwise intact checkpoint directory."""
     p = os.path.join(directory, _LATEST)
-    if not os.path.exists(p):
+    if os.path.exists(p):
+        with open(p) as f:
+            raw = f.read().strip()
+        try:
+            step = int(raw)
+        except ValueError:
+            step = None  # torn/garbage pointer: recover by scan below
+        if step is not None and os.path.exists(
+            os.path.join(directory, f"step_{step}.npz")
+        ):
+            return step
+    if not os.path.isdir(directory):
         return None
-    with open(p) as f:
-        step = int(f.read().strip())
-    if not os.path.exists(os.path.join(directory, f"step_{step}.npz")):
-        return None
-    return step
+    steps = _scan_steps(directory)
+    return steps[-1] if steps else None
 
 
 def load_checkpoint(directory: str, step: Optional[int] = None) -> Tuple[Any, int]:

@@ -71,6 +71,34 @@ def test_latest_step_empty(tmp_path):
         load_checkpoint(str(tmp_path))
 
 
+def test_latest_step_recovers_from_torn_pointer(tmp_path):
+    """The LATEST pointer is an optimization, not the source of truth: a
+    torn/garbage/stale pointer must never strand the self-contained
+    step files — recovery falls back to scanning step_<N>.npz."""
+    d = str(tmp_path)
+    save_checkpoint(d, {"x": jnp.arange(3.0)}, 2)
+    save_checkpoint(d, {"x": jnp.arange(4.0)}, 7)
+
+    # Torn write: partial/garbage content in LATEST.
+    (tmp_path / "LATEST").write_text("7\x00\xf3garbage")
+    assert latest_step(d) == 7
+
+    # Stale pointer at a step whose file was pruned.
+    (tmp_path / "LATEST").write_text("99")
+    assert latest_step(d) == 7
+    restored, step = load_checkpoint(d)
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(restored["x"]), np.arange(4.0))
+
+    # Pointer missing entirely.
+    (tmp_path / "LATEST").unlink()
+    assert latest_step(d) == 7
+
+    # A valid pointer still wins over the scan (points at 2, not max 7).
+    (tmp_path / "LATEST").write_text("2")
+    assert latest_step(d) == 2
+
+
 def _glmix_setup(seed=0):
     rng = np.random.default_rng(seed)
     n, d_fix, d_re, E = 512, 8, 4, 16

@@ -1,0 +1,552 @@
+"""Online serving tests: micro-batcher semantics, hot/cold store residency,
+engine parity + the zero-retrace contract, zero-downtime reload, and the
+stdlib HTTP front end.
+
+The parity assertions are atol=0 by design: the serving engine runs the SAME
+jitted GameTransformer program as the batch driver, and the dense scorer's
+per-row reduction is bit-stable across row counts (models/coefficients.py) —
+so a micro-batched score must EQUAL the full-batch score, and any drift is a
+real bug, not float noise. The reference here is therefore the batch path
+itself (full (E, d) tables, true entity indices, one big batch), never
+re-derived host math.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.index_map import EntityIndex, IndexMap
+from photon_tpu.data.padding import bucket_grid, bucket_pow2, pad_game_batch
+from photon_tpu.estimators.game_transformer import GameTransformer
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.serve import (
+    BackpressureError,
+    DeadlineExceededError,
+    HotColdEntityStore,
+    MicroBatcher,
+    ScoreRequest,
+    ServeConfig,
+    ServingEngine,
+)
+from photon_tpu.types import TaskType
+
+rng = np.random.default_rng(41)
+
+D_FIX, D_RE, N_ENTITIES = 6, 4, 64
+
+
+def make_model(scale=1.0, n_entities=N_ENTITIES):
+    w_fix = (scale * np.linspace(-1, 1, D_FIX)).astype(np.float32)
+    w_re = (scale * rng.normal(size=(n_entities, D_RE))).astype(np.float32)
+    return GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(np.asarray(w_fix)), TaskType.LOGISTIC_REGRESSION
+            ),
+            "shardA",
+        ),
+        "per_user": RandomEffectModel(
+            np.asarray(w_re), "userId", "shardB", TaskType.LOGISTIC_REGRESSION
+        ),
+    })
+
+
+def make_entity_index(n=N_ENTITIES):
+    eidx = EntityIndex()
+    for e in range(n):
+        eidx.intern(f"user{e}")
+    return eidx
+
+
+def batch_scores(model, xa, xb, users, offset=0.0):
+    """Reference scores via the BATCH path: the full-table model scored as
+    one n-row batch through the same jitted transformer program serving
+    uses. Row-count invariance of the dense reduction makes this directly
+    comparable (atol=0) to per-request micro-batched scores."""
+    import jax
+
+    n = len(users)
+    b = GameBatch(
+        label=jnp.zeros(n, jnp.float32),
+        offset=jnp.full(n, offset, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+        features={"shardA": jnp.asarray(xa), "shardB": jnp.asarray(xb)},
+        entity_ids={"userId": jnp.asarray(np.asarray(users), jnp.int32)},
+    )
+    return np.asarray(GameTransformer(jax.device_put(model)).transform(b),
+                      np.float32)
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher (stub score_fn — no jax, pure threading semantics)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_flushes_on_size():
+    batches = []
+
+    def score(reqs):
+        batches.append(len(reqs))
+        return [r.offset for r in reqs]
+
+    mb = MicroBatcher(score, max_batch_size=4, max_delay_s=10.0, queue_cap=64)
+    futs = [mb.submit(ScoreRequest({}, offset=float(i))) for i in range(8)]
+    assert [f.result(timeout=5) for f in futs] == [float(i) for i in range(8)]
+    mb.close()
+    # Size-triggered flushing: no batch above the cap, and the 10s deadline
+    # never fired (the test finishes in milliseconds).
+    assert sum(batches) == 8 and max(batches) <= 4
+
+
+def test_batcher_flushes_on_deadline():
+    mb = MicroBatcher(
+        lambda reqs: [1.0] * len(reqs),
+        max_batch_size=1000, max_delay_s=0.02, queue_cap=64,
+    )
+    t0 = time.monotonic()
+    assert mb.submit(ScoreRequest({})).result(timeout=5) == 1.0
+    # One request can never fill max_batch_size: the deadline flushed it.
+    assert time.monotonic() - t0 < 2.0
+    mb.close()
+
+
+def test_batcher_sheds_on_backpressure():
+    release = threading.Event()
+
+    def slow(reqs):
+        release.wait(5)
+        return [0.0] * len(reqs)
+
+    mb = MicroBatcher(slow, max_batch_size=1, max_delay_s=0.0, queue_cap=2)
+    futs = [mb.submit(ScoreRequest({})) for _ in range(2)]
+    shed = 0
+    for _ in range(20):
+        try:
+            futs.append(mb.submit(ScoreRequest({})))
+        except BackpressureError:
+            shed += 1
+    assert shed > 0  # depth was at cap while the flusher sat blocked
+    release.set()
+    for f in futs:
+        assert f.result(timeout=10) == 0.0
+    mb.close()
+
+
+def test_batcher_expires_deadline_in_queue():
+    release = threading.Event()
+
+    def slow(reqs):
+        release.wait(5)
+        return [0.0] * len(reqs)
+
+    mb = MicroBatcher(slow, max_batch_size=1, max_delay_s=0.0, queue_cap=64)
+    blocker = mb.submit(ScoreRequest({}))  # occupies the flusher
+    doomed = mb.submit(ScoreRequest({}), deadline_s=0.01)
+    time.sleep(0.05)
+    release.set()
+    assert blocker.result(timeout=10) == 0.0
+    # The doomed request expired while queued: it fails WITHOUT scorer time.
+    with pytest.raises(DeadlineExceededError):
+        doomed.result(timeout=10)
+    mb.close()
+
+
+def test_batcher_score_error_fails_batch_not_batcher():
+    calls = []
+
+    def flaky(reqs):
+        calls.append(len(reqs))
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return [2.0] * len(reqs)
+
+    mb = MicroBatcher(flaky, max_batch_size=8, max_delay_s=0.005, queue_cap=8)
+    bad = mb.submit(ScoreRequest({}))
+    with pytest.raises(RuntimeError, match="boom"):
+        bad.result(timeout=5)
+    good = mb.submit(ScoreRequest({}))  # the batcher itself kept serving
+    assert good.result(timeout=5) == 2.0
+    mb.close()
+
+
+# ---------------------------------------------------------------------------
+# Hot/cold entity store
+# ---------------------------------------------------------------------------
+
+
+def test_store_pins_when_budget_covers_table():
+    model = make_model()
+    w_re = np.asarray(model.models["per_user"].coefficients)
+    store = HotColdEntityStore(
+        model, {"userId": make_entity_index()}, hot_bytes=1 << 30
+    )
+    assert store.group("userId").pinned
+    # Pinned: entity ids pass through as slots; unknown ids resolve -1.
+    slots = store.resolve("userId", ["user3", "user0", "nope", 5])
+    np.testing.assert_array_equal(slots, [3, 0, -1, 5])
+    table = np.asarray(store.scoring_model().models["per_user"].coefficients)
+    np.testing.assert_array_equal(table, w_re)
+
+
+def test_store_lru_promotes_and_demotes():
+    model = make_model()
+    w_re = np.asarray(model.models["per_user"].coefficients)
+    # ~0-byte budget: capacity floors at min_hot_rows=8 < 64 entities.
+    store = HotColdEntityStore(
+        model, {"userId": make_entity_index()}, hot_bytes=1, min_hot_rows=8
+    )
+    group = store.group("userId")
+    assert not group.pinned and group.capacity == 8
+
+    slots = store.resolve("userId", [f"user{e}" for e in range(8)])
+    assert sorted(slots) == list(range(8))
+    table = np.asarray(store.scoring_model().models["per_user"].coefficients)
+    for e in range(8):  # promoted rows hold the exact host coefficients
+        np.testing.assert_array_equal(table[slots[e]], w_re[e])
+
+    # Touch user0 (now MRU), then promote 7 fresh entities: the LRU victims
+    # are users 1..7; user0 must survive in its slot, untouched.
+    keep = store.resolve("userId", ["user0"])[0]
+    slots2 = store.resolve("userId", [f"user{e}" for e in range(8, 15)])
+    assert store.resolve("userId", ["user0"])[0] == keep
+    table2 = np.asarray(store.scoring_model().models["per_user"].coefficients)
+    np.testing.assert_array_equal(table2[keep], w_re[0])
+    for j, e in enumerate(range(8, 15)):
+        np.testing.assert_array_equal(table2[slots2[j]], w_re[e])
+
+
+def test_store_overflow_batch_raises():
+    store = HotColdEntityStore(
+        make_model(), {"userId": make_entity_index()},
+        hot_bytes=1, min_hot_rows=4,
+    )
+    # 5 unique entities in one batch > capacity 4: every resident slot is
+    # in use by THIS batch, so there is no LRU victim to demote.
+    with pytest.raises(RuntimeError, match="exhausted"):
+        store.resolve("userId", [f"user{e}" for e in range(5)])
+
+
+def test_store_cold_and_unknown_entities_resolve_minus_one():
+    store = HotColdEntityStore(
+        make_model(), {"userId": make_entity_index()},
+        hot_bytes=1, min_hot_rows=8,
+    )
+    slots = store.resolve("userId", ["never-seen", -1, 10_000])
+    np.testing.assert_array_equal(slots, [-1, -1, -1])
+    assert store.resolve("noSuchType", ["x"]).tolist() == [-1]
+
+
+# ---------------------------------------------------------------------------
+# Engine: parity, zero retraces, reload
+# ---------------------------------------------------------------------------
+
+
+def make_engine(scale=1.0, **cfg):
+    model = make_model(scale)
+    defaults = dict(max_batch_size=8, max_delay_ms=1.0, hot_bytes=1)
+    defaults.update(cfg)
+    eng = ServingEngine(
+        model,
+        entity_indexes={"userId": make_entity_index()},
+        config=ServeConfig(**defaults),
+    )
+    return eng, model
+
+
+def test_engine_concurrent_parity_and_zero_retraces():
+    eng, model = make_engine()
+    n = 200
+    xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+    xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+    users = rng.integers(-1, N_ENTITIES, size=n)
+    expected = batch_scores(model, xa, xb, users, offset=0.25)
+
+    results = [None] * n
+
+    def worker(lo, hi):
+        futs = [
+            (i, eng.submit(ScoreRequest(
+                {"shardA": xa[i], "shardB": xb[i]},
+                {"userId": f"user{users[i]}" if users[i] >= 0 else "cold"},
+                offset=0.25,
+            )))
+            for i in range(lo, hi)
+        ]
+        for i, f in futs:
+            results[i] = np.float32(f.result(timeout=30))
+
+    threads = [
+        threading.Thread(target=worker, args=(lo, min(lo + 25, n)))
+        for lo in range(0, n, 25)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # Hot capacity is 8 of 64 entities (hot_bytes=1): these 200 requests
+    # churned the LRU hard, and every score still equals the batch path's.
+    np.testing.assert_array_equal(np.asarray(results, np.float32), expected)
+    assert eng.retraces_since_warmup == 0, eng.stats()
+    eng.close()
+
+
+def test_engine_batch_size_invariance_bit_exact():
+    """The same request must score bit-identically whether it rides a
+    1-row, 3-row, or full batch — the property the batch-driver parity
+    stage (ci.sh serve) builds on."""
+    eng, _ = make_engine()
+    xa = rng.normal(size=(8, D_FIX)).astype(np.float32)
+    xb = rng.normal(size=(8, D_RE)).astype(np.float32)
+    reqs = [
+        ScoreRequest({"shardA": xa[i], "shardB": xb[i]}, {"userId": i})
+        for i in range(8)
+    ]
+    solo = np.asarray([eng._score_batch([r])[0] for r in reqs], np.float32)
+    grouped = np.asarray(eng._score_batch(reqs), np.float32)
+    np.testing.assert_array_equal(solo, grouped)
+    ragged = np.concatenate([
+        np.asarray(eng._score_batch(reqs[:3]), np.float32),
+        np.asarray(eng._score_batch(reqs[3:]), np.float32),
+    ])
+    np.testing.assert_array_equal(ragged, grouped)
+    assert eng.retraces_since_warmup == 0
+    eng.close()
+
+
+def test_engine_dict_features_and_intercept():
+    imap = IndexMap.build(
+        [f"f{j}" for j in range(D_FIX - 1)], add_intercept=True
+    )
+    eng = ServingEngine(
+        make_model(),
+        entity_indexes={"userId": make_entity_index()},
+        index_maps={"shardA": imap},
+        config=ServeConfig(max_batch_size=4, max_delay_ms=1.0),
+    )
+    named = {f"f{j}": 0.5 * j for j in range(D_FIX - 1)}
+    dense = np.zeros(D_FIX, np.float32)
+    for k, v in named.items():
+        dense[imap.get_index(k)] = v
+    dense[imap.get_index(IndexMap.INTERCEPT)] = 1.0  # dict path auto-sets it
+    s_named = eng.score({"shardA": named}, {"userId": "user1"})
+    s_dense = eng.score({"shardA": dense}, {"userId": "user1"})
+    assert np.float32(s_named) == np.float32(s_dense)
+    # Unknown feature names drop silently (batch reader parity).
+    s_extra = eng.score(
+        {"shardA": {**named, "not-a-feature": 9.9}}, {"userId": "user1"}
+    )
+    assert np.float32(s_extra) == np.float32(s_named)
+    eng.close()
+
+
+def test_engine_reload_is_zero_downtime_and_retrace_free():
+    eng, model = make_engine()
+    xa = rng.normal(size=(1, D_FIX)).astype(np.float32)
+    xb = rng.normal(size=(1, D_RE)).astype(np.float32)
+    req = dict(features={"shardA": xa[0], "shardB": xb[0]},
+               entity_ids={"userId": "user2"})
+    s1 = np.float32(eng.score(**req))
+    assert s1 == batch_scores(model, xa, xb, [2])[0]
+
+    model2 = make_model(scale=-3.0)
+    info = eng.reload(model2, "v2")
+    assert info["model_version"] == "v2" and eng.model_version == "v2"
+    s2 = np.float32(eng.score(**req))
+    assert s2 == batch_scores(model2, xa, xb, [2])[0]
+    assert s2 != s1
+    # The new generation warmed its own transformer BEFORE the swap, so the
+    # retrace contract holds across the reload too.
+    assert eng.retraces_since_warmup == 0
+    eng.close()
+
+
+def test_engine_rejects_bad_feature_width():
+    eng, _ = make_engine()
+    with pytest.raises(ValueError, match="expects"):
+        eng.score({"shardA": np.zeros(D_FIX + 1, np.float32),
+                   "shardB": np.zeros(D_RE, np.float32)})
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Transformer warm-up / trace_count across mixed bucket shapes (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _bucket(n):
+    from photon_tpu.data.random_effect import bucket_dim
+
+    return bucket_dim(n)
+
+
+def _batch_of(n):
+    return GameBatch(
+        label=jnp.zeros(n, jnp.float32),
+        offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+        features={
+            "shardA": jnp.asarray(
+                rng.normal(size=(n, D_FIX)).astype(np.float32)
+            ),
+            "shardB": jnp.asarray(
+                rng.normal(size=(n, D_RE)).astype(np.float32)
+            ),
+        },
+        entity_ids={
+            "userId": jnp.asarray(
+                rng.integers(0, N_ENTITIES, size=n).astype(np.int32)
+            )
+        },
+    )
+
+
+def test_transformer_trace_count_reused_across_mixed_buckets():
+    import jax
+
+    dev_model = jax.device_put(make_model())
+    tr = GameTransformer(dev_model)
+    # Mixed bucket shapes, repeated: one trace per DISTINCT shape, zero for
+    # repeats — trace_count counts XLA traces, not Python calls.
+    for n in (8, 16, 8, 16, 32, 8, 32, 16):
+        tr.transform(_batch_of(n))
+    assert tr.trace_count == 3
+
+    # warm_up covers the whole grid up front; subsequent mixed-shape
+    # traffic padded onto the grid then never traces (the serving
+    # startup contract).
+    tr2 = GameTransformer(dev_model)
+    traced = tr2.warm_up(_batch_of(1), bucket_grid(32))
+    assert traced == len(set(bucket_grid(32)))
+    before = tr2.trace_count
+    for n in (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 5, 7, 17):
+        tr2.transform(pad_game_batch(_batch_of(n), _bucket(n), xp=jnp))
+    assert tr2.trace_count == before
+
+
+def test_bucket_grid_covers_every_dispatch_size():
+    for max_n in (1, 2, 7, 8, 33, 64):
+        grid = bucket_grid(max_n)
+        for n in range(1, max_n + 1):
+            assert _bucket(n) in grid
+        assert grid == sorted(set(grid))
+    assert bucket_pow2(0) == 1 and bucket_pow2(5) == 8
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end (handler-level: real sockets, ephemeral port)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def http_server():
+    from http.server import ThreadingHTTPServer
+
+    from photon_tpu.cli.game_serving import make_handler
+
+    eng, model = make_engine(max_batch_size=4)
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(eng, None))
+    server.daemon_threads = True
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server.server_address[1], model
+    server.shutdown()
+    server.server_close()
+    eng.close()
+
+
+def _post(port, path, payload: bytes):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=payload, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.read()
+
+
+def test_http_score_and_health(http_server):
+    port, model = http_server
+    xa = rng.normal(size=D_FIX).astype(np.float32)
+    xb = rng.normal(size=D_RE).astype(np.float32)
+    body = json.dumps({
+        "features": {"shardA": xa.tolist(), "shardB": xb.tolist()},
+        "entityIds": {"userId": "user5"},
+        "offset": 1.0,
+    }).encode()
+    out = json.loads(_post(port, "/v1/score", body))
+    # float32 → python float → JSON → back is exact: parity survives HTTP.
+    expected = batch_scores(model, xa[None], xb[None], [5], offset=1.0)[0]
+    assert np.float32(out["score"]) == expected
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=10
+    ) as resp:
+        health = json.loads(resp.read())
+    assert health["retraces_since_warmup"] == 0
+    assert "userId" in health["store"]
+
+
+def test_http_score_batch_jsonl_preserves_order(http_server):
+    port, model = http_server
+    n = 12
+    xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+    xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+    users = np.arange(n)
+    lines = "".join(
+        json.dumps({
+            "features": {"shardA": xa[i].tolist(), "shardB": xb[i].tolist()},
+            "entityIds": {"userId": int(users[i])},
+        }) + "\n"
+        for i in range(n)
+    )
+    raw = _post(port, "/v1/score-batch", lines.encode()).decode()
+    got = np.asarray(
+        [json.loads(line)["score"] for line in raw.splitlines()], np.float32
+    )
+    np.testing.assert_array_equal(got, batch_scores(model, xa, xb, users))
+
+
+def test_http_bad_request_is_400_not_crash(http_server):
+    port, _ = http_server
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=b"not json", method="POST"
+    )
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req, timeout=10)
+    assert err.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# Shared padding helper (dedupe satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_pad_game_batch_identity_and_inertness():
+    import jax
+
+    model = make_model()
+    n = 5
+    b = _batch_of(n)
+    assert pad_game_batch(b, n, xp=jnp) is b  # no-op → identity
+    padded = pad_game_batch(b, 8, xp=jnp)
+    assert padded.n == 8
+    np.testing.assert_array_equal(np.asarray(padded.weight)[n:], 0.0)
+    np.testing.assert_array_equal(
+        np.asarray(padded.entity_ids["userId"])[n:], -1
+    )
+    # Inert padding: real-row scores are unchanged by the extra rows.
+    tr = GameTransformer(jax.device_put(model))
+    np.testing.assert_array_equal(
+        np.asarray(tr.transform(padded))[:n], np.asarray(tr.transform(b))
+    )

@@ -121,7 +121,41 @@ def test_registry_instruments_and_labels():
     for v in (1, 5, 3):
         h.observe(v)
     d = h.as_dict()
-    assert d["stats"] == dict(count=3, sum=9.0, min=1.0, max=5.0, mean=3.0)
+    assert d["stats"] == dict(
+        count=3, sum=9.0, min=1.0, max=5.0, mean=3.0,
+        p50=3.0, p95=4.8, p99=4.96,
+    )
+
+
+def test_histogram_percentiles_deterministic_and_bounded():
+    reg = registry()
+    h = reg.histogram("latency_s")
+    # Exact below the reservoir cap: matches numpy's linear interpolation.
+    values = list(range(1000))
+    for v in values:
+        h.observe(float(v))
+    p = h.percentiles()
+    assert p["p50"] == pytest.approx(np.percentile(values, 50))
+    assert p["p95"] == pytest.approx(np.percentile(values, 95))
+    assert p["p99"] == pytest.approx(np.percentile(values, 99))
+
+    # Past the cap the strided reservoir stays bounded and approximate:
+    # identical sequences give identical (deterministic) results.
+    h2 = reg.histogram("latency2_s")
+    h3 = reg.histogram("latency3_s")
+    n = h2.RESERVOIR_CAP * 3
+    for i in range(n):
+        h2.observe(float(i))
+        h3.observe(float(i))
+    assert len(h2._sample) < h2.RESERVOIR_CAP
+    assert h2.percentiles() == h3.percentiles()
+    assert h2.percentiles()["p50"] == pytest.approx(n / 2, rel=0.01)
+    assert h2.count == n and h2.max == float(n - 1)
+
+    # Empty histogram reports None, not a crash.
+    assert registry().histogram("nothing").percentiles() == {
+        "p50": None, "p95": None, "p99": None,
+    }
 
 
 def test_registry_rejects_kind_change_and_negative_counter():
@@ -370,7 +404,7 @@ def test_train_glm_telemetry_out(tmp_path):
     for r in records:
         by_kind.setdefault(r["record"], []).append(r)
     (meta,) = by_kind["meta"]
-    assert meta["driver"] == "train_glm" and meta["schema_version"] == 1
+    assert meta["driver"] == "train_glm" and meta["schema_version"] == 2
     (env,) = by_kind["env"]
     assert env["device_count"] >= 1 and env["jax_backend"]
     # One solve span per λ (the driver's per-coordinate unit).
