@@ -1113,6 +1113,188 @@ def run_serve_ab(n_requests: int = 2000, d: int = 32, E: int = 2000):
     }
 
 
+def run_fault_soak(n_requests: int = 3000, d: int = 32, E: int = 512):
+    """Serving soak under continuous fault injection (utils/faults.py).
+
+    Eight producer threads push scoring traffic through the micro-batcher
+    while (1) the entity-store resolve path fails with probability 0.2
+    (seeded, deterministic) so the per-RE-type circuit breaker trips,
+    degrades to FE-only scoring, cools down, and recovers — repeatedly;
+    and (2) a churn thread hot-reloads the model every ~20 ms with half
+    the reloads injected to fail (the engine must keep the old model).
+
+    Acceptance (ISSUE 6): ZERO caller-visible crashes — every request
+    resolves to a score or an explicit shed, the process never dies, and
+    after the fault plan is cleared the engine reports healthy again.
+    """
+    import threading
+
+    from photon_tpu.data.index_map import EntityIndex
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.serve import ScoreRequest, ServeConfig, ServingEngine
+    from photon_tpu.serve.engine import ReloadError
+    from photon_tpu.types import TaskType
+    from photon_tpu.utils import faults
+
+    rng = np.random.default_rng(29)
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"u{e}")
+    w_fix = rng.normal(size=d).astype(np.float32)
+
+    def make_model(scale=1.0):
+        return GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(np.asarray(w_fix)),
+                    TaskType.LOGISTIC_REGRESSION,
+                ),
+                "s",
+            ),
+            "per_user": RandomEffectModel(
+                (rng.normal(size=(E, d)) * scale / 4).astype(np.float32),
+                "userId", "s", TaskType.LOGISTIC_REGRESSION,
+            ),
+        })
+
+    X = rng.normal(size=(n_requests, d)).astype(np.float32)
+    users = rng.integers(0, E, size=n_requests)
+
+    def counters(prefix="serve_"):
+        return {
+            f"{m['metric']}{m.get('labels') or ''}": m["value"]
+            for m in registry().snapshot()
+            if m["type"] == "counter" and m["metric"].startswith(prefix)
+        }
+
+    before = counters()
+    faults.configure(faults.FaultPlan.from_obj({
+        "seed": 33,
+        "rules": [
+            {"site": "serve.store_resolve", "kind": "transient", "p": 0.2},
+            {"site": "serve.reload", "kind": "permanent", "p": 0.5},
+        ],
+    }))
+    engine = ServingEngine(
+        make_model(), entity_indexes={"userId": eidx},
+        config=ServeConfig(max_batch_size=32, max_delay_ms=2.0,
+                           queue_cap=n_requests, hot_bytes=1 << 30,
+                           breaker_threshold=2, breaker_cooldown_s=0.15),
+    )
+    _progress(f"fault soak: {n_requests} requests, resolve p=0.2, "
+              "reload churn p=0.5")
+
+    ok = shed = errors = 0
+    latencies = []
+    lock = threading.Lock()
+    done = threading.Event()
+
+    def producer(lo, hi):
+        nonlocal ok, shed, errors
+        from photon_tpu.serve import BackpressureError
+
+        for i in range(lo, hi):
+            t0 = time.perf_counter()
+            try:
+                engine.submit(ScoreRequest(
+                    {"s": X[i]}, {"userId": f"u{users[i]}"}
+                )).result(timeout=120)
+                with lock:
+                    ok += 1
+                    latencies.append(time.perf_counter() - t0)
+            except BackpressureError:
+                with lock:
+                    shed += 1
+            except Exception:  # noqa: BLE001 — any other escape is a crash
+                with lock:
+                    errors += 1
+
+    reload_ok = reload_failed = 0
+
+    def churn():
+        nonlocal reload_ok, reload_failed
+        gen = 0
+        while not done.wait(0.02):
+            gen += 1
+            try:
+                engine.reload(make_model(scale=1 + 0.01 * gen), f"v{gen}")
+                reload_ok += 1
+            except ReloadError:
+                reload_failed += 1
+
+    step = (n_requests + 7) // 8
+    threads = [
+        threading.Thread(target=producer, args=(lo, min(lo + step, n_requests)))
+        for lo in range(0, n_requests, step)
+    ]
+    churner = threading.Thread(target=churn)
+    t0 = time.perf_counter()
+    churner.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    done.set()
+    churner.join()
+    wall = time.perf_counter() - t0
+
+    # Faults off: the engine must report healthy again once a clean reload
+    # clears the last failure and the breaker cooldown elapses.
+    injected = dict(faults.injector().counts())
+    faults.reset()
+    time.sleep(0.2)
+    engine.reload(make_model(), "v-final")
+    final_scores = [
+        engine.submit(ScoreRequest(
+            {"s": X[i]}, {"userId": f"u{users[i]}"}
+        )).result(timeout=120)
+        for i in range(32)
+    ]
+    stats = engine.stats()
+    engine.close()
+
+    delta = {
+        k: v - before.get(k, 0)
+        for k, v in counters().items()
+        if v != before.get(k, 0)
+    }
+    trips = sum(v for k, v in delta.items()
+                if k.startswith("serve_breaker_trips_total"))
+    degraded = sum(v for k, v in delta.items()
+                   if k.startswith("serve_requests_degraded_total"))
+    assert errors == 0, f"{errors} caller-visible crashes during soak"
+    assert ok + shed == n_requests, (ok, shed, n_requests)
+    assert trips >= 1, f"resolve p=0.2 must trip the breaker: {delta}"
+    assert reload_failed >= 1 and reload_ok >= 1, (reload_ok, reload_failed)
+    assert not stats["degraded"], f"engine still degraded after reset: {stats}"
+    assert all(np.isfinite(s) for s in final_scores)
+    lat = np.sort(np.asarray(latencies)) * 1e3
+    return {
+        "metric": "fault_soak",
+        "unit": "requests",
+        "value": n_requests,
+        "wall_s": round(wall, 3),
+        "ok": ok,
+        "shed": shed,
+        "caller_errors": errors,
+        "breaker_trips": trips,
+        "degraded_scores": degraded,
+        "reloads_ok": reload_ok,
+        "reloads_failed": reload_failed,
+        "recovered": not stats["degraded"],
+        "p50_ms": round(float(lat[len(lat) // 2]), 2),
+        "p99_ms": round(float(lat[int(len(lat) * 0.99)]), 2),
+        "faults_injected": injected,
+    }
+
+
 def measure_cpu_baseline():
     """Same workload on CPU: scipy L-BFGS-B fixed effect + per-entity scipy
     solves, with identical data-pass accounting."""
@@ -1450,6 +1632,11 @@ def main():
         # Micro-batched vs per-request online serving: ≥2x throughput,
         # bit-identical scores, zero retraces after warm-up; CPU-measurable.
         print(json.dumps(run_serve_ab()))
+        return
+    if "--fault-soak" in sys.argv:
+        # Serving soak under injected store faults + reload churn: zero
+        # caller-visible crashes, breaker trips + recovers; CPU-measurable.
+        print(json.dumps(run_fault_soak()))
         return
     if "--rmatvec-cpu-ab" in sys.argv:
         # Four sparse-rmatvec lowerings head-to-head at CPU-mesh scale

@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|serve|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|serve|faults|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -327,6 +327,78 @@ EOF
     rm -rf "$tmp"
 }
 
+run_faults() {
+    # Crash-safe resume smoke: SIGKILL the trainer mid-sweep via the
+    # fault-injection harness (kill fires right after the first checkpoint
+    # publish), then rerun with --resume and assert the final artifacts
+    # match an uninterrupted baseline run to rel 1e-6 per λ.
+    echo "== faults: SIGKILL mid-train + --resume objective parity =="
+    tmp="$(mktemp -d)"
+    python - "$tmp" <<'EOF'
+import json, os, signal, subprocess, sys
+import numpy as np
+
+tmp = sys.argv[1]
+rng = np.random.default_rng(11)
+lines = []
+for _ in range(120):
+    x = rng.normal(size=4)
+    y = 1 if rng.uniform() < 1 / (1 + np.exp(-(x[0] - x[2]))) else -1
+    feats = " ".join(f"{j + 1}:{x[j]:.4f}" for j in range(4))
+    lines.append(f"{y:+d} {feats}")
+data = os.path.join(tmp, "train.txt")
+with open(data, "w") as f:
+    f.write("\n".join(lines))
+
+def run(outdir, resume=False, plan=None):
+    env = dict(os.environ)
+    env.pop("PHOTON_TPU_FAULT_PLAN", None)
+    if plan is not None:
+        env["PHOTON_TPU_FAULT_PLAN"] = json.dumps(plan)
+    cmd = [sys.executable, "-m", "photon_tpu.cli.train_glm",
+           "--training-data", data, "--format", "libsvm",
+           "--output-dir", outdir,
+           "--checkpoint-dir", os.path.join(outdir, "ckpt"),
+           "--regularization-weights", "10,1,0.1",
+           "--max-iterations", "15"]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+
+base = os.path.join(tmp, "base")
+r = run(base)
+assert r.returncode == 0, r.stderr
+
+faulted = os.path.join(tmp, "faulted")
+kill_plan = {"rules": [{"site": "checkpoint.after_save", "kind": "kill",
+                        "at": [0]}]}
+r = run(faulted, plan=kill_plan)
+assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+
+r = run(faulted, resume=True)
+assert r.returncode == 0, r.stderr
+assert "resuming" in (r.stdout + r.stderr)
+
+def summary(outdir):
+    with open(os.path.join(outdir, "training-summary.json")) as f:
+        return json.load(f)
+
+a, b = summary(base), summary(faulted)
+assert a["best_lambda"] == b["best_lambda"], (a, b)
+assert len(b["models"]) == len(a["models"]) == 3, b
+worst = 0.0
+for ma, mb in zip(a["models"], b["models"]):
+    assert ma["lambda"] == mb["lambda"]
+    rel = abs(mb["loss"] - ma["loss"]) / max(abs(ma["loss"]), 1e-30)
+    worst = max(worst, rel)
+    assert rel <= 1e-6, (ma, mb, rel)
+print(f"   kill @ first checkpoint, resume parity: "
+      f"worst per-λ loss rel {worst:.2e} (≤ 1e-6) OK")
+EOF
+    rm -rf "$tmp"
+}
+
 run_install() {
     echo "== packaging: editable install + console entry points =="
     tmp="$(mktemp -d)"
@@ -356,8 +428,9 @@ case "$stage" in
     telemetry) run_telemetry ;;
     active-set) run_active_set ;;
     serve) run_serve ;;
+    faults) run_faults ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_serve; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_serve; run_faults; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

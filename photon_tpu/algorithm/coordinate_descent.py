@@ -166,28 +166,29 @@ class CoordinateDescent:
                 raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
             from photon_tpu.utils.checkpoint import (
                 LegacyCheckpointError,
-                latest_step,
                 load_checkpoint,
             )
 
             tag = checkpoint_tag or ",".join(self.update_sequence)
-            step = latest_step(checkpoint_dir)
-            if step is not None:
-                try:
-                    state, _ = load_checkpoint(checkpoint_dir, step)
-                except LegacyCheckpointError as exc:
-                    # A v1 (pickle) checkpoint written by an older version:
-                    # an upgrade must not turn a resumable job into a crash
-                    # loop — restart the sweep from step 0 (ADVICE r3).
-                    # Corrupt v2 checkpoints still raise (they are NOT
-                    # silently discarded).
-                    logger.warning(
-                        "ignoring unreadable legacy checkpoint at %s (%s); "
-                        "restarting training from step 0",
-                        checkpoint_dir, exc,
-                    )
-                    step = None
-            if step is not None:
+            state = step = None
+            try:
+                # step=None → resume-robust load: a torn newest step (machine
+                # crash mid-save) is skipped with a warning and the run
+                # resumes one pass earlier; it raises only when EVERY step is
+                # unreadable (corruption is never silently discarded).
+                state, step = load_checkpoint(checkpoint_dir)
+            except FileNotFoundError:
+                pass  # fresh directory: nothing to resume
+            except LegacyCheckpointError as exc:
+                # Only v1 (pickle) checkpoints remain: an upgrade must not
+                # turn a resumable job into a crash loop — restart the sweep
+                # from step 0 (ADVICE r3).
+                logger.warning(
+                    "ignoring unreadable legacy checkpoint at %s (%s); "
+                    "restarting training from step 0",
+                    checkpoint_dir, exc,
+                )
+            if state is not None:
                 if state.get("tag") != tag:
                     raise ValueError(
                         f"checkpoint at {checkpoint_dir} was written for a "
@@ -195,17 +196,28 @@ class CoordinateDescent:
                         f" != current {tag!r}); clear the directory or point "
                         "checkpoint_dir elsewhere"
                     )
-                models = state["models"]
-                scores = state["scores"]
-                total_scores = state["total_scores"]
-                metric_history = state["metric_history"]
-                best_metric = state["best_metric"]
-                best_model = state["best_model"]
-                tracker = state["tracker"]
-                wall_times = state.get(
-                    "wall_times", {cid: [] for cid in self.update_sequence}
-                )
+                with span("cd/resume_restore"):
+                    models = state["models"]
+                    scores = state["scores"]
+                    total_scores = state["total_scores"]
+                    metric_history = state["metric_history"]
+                    best_metric = state["best_metric"]
+                    best_model = state["best_model"]
+                    tracker = state["tracker"]
+                    wall_times = state.get(
+                        "wall_times", {cid: [] for cid in self.update_sequence}
+                    )
+                    # Reinstall per-coordinate active-set gate state (pass
+                    # counter + keep masks) so the first resumed pass is gated
+                    # exactly like an uninterrupted run's would be. Older
+                    # checkpoints without the field restore to a full pass.
+                    active_state = state.get("active_state") or {}
+                    for cid, coord in self.coordinates.items():
+                        restore = getattr(coord, "restore_active_state", None)
+                        if restore is not None:
+                            restore(active_state.get(cid))
                 start_it = step + 1
+                registry().counter("cd_resumes_total").inc()
                 logger.info(
                     "resuming coordinate descent from checkpoint step %d", step
                 )
@@ -289,24 +301,59 @@ class CoordinateDescent:
 
             registry().counter("cd_iterations_total").inc()
 
-            if checkpoint_dir is not None and (it + 1) % checkpoint_every == 0:
+            def _save_checkpoint(it=it):
                 from photon_tpu.utils.checkpoint import save_checkpoint
 
-                save_checkpoint(
-                    checkpoint_dir,
-                    dict(
-                        models=models,
-                        scores=scores,
-                        total_scores=total_scores,
-                        metric_history=metric_history,
-                        best_metric=best_metric,
-                        best_model=best_model,
-                        tracker=tracker,
-                        wall_times=wall_times,
-                        tag=checkpoint_tag or ",".join(self.update_sequence),
-                    ),
-                    it,
+                with span("cd/checkpoint_save"):
+                    # Active-set gate state rides along (duck-typed): the
+                    # resolved keep masks are host bools; the save gathers
+                    # every device array anyway, so this adds no extra syncs.
+                    active_state = {
+                        cid: coord.export_active_state()
+                        for cid, coord in self.coordinates.items()
+                        if getattr(coord, "export_active_state", None)
+                        is not None
+                    }
+                    save_checkpoint(
+                        checkpoint_dir,
+                        dict(
+                            models=models,
+                            scores=scores,
+                            total_scores=total_scores,
+                            metric_history=metric_history,
+                            best_metric=best_metric,
+                            best_model=best_model,
+                            tracker=tracker,
+                            wall_times=wall_times,
+                            active_state=active_state,
+                            tag=checkpoint_tag or ",".join(self.update_sequence),
+                        ),
+                        it,
+                    )
+
+            saved = False
+            if checkpoint_dir is not None and (it + 1) % checkpoint_every == 0:
+                _save_checkpoint()
+                saved = True
+
+            # Cooperative SIGTERM/SIGINT: the pass boundary is the safe stop
+            # — every coordinate's state is consistent and (when a
+            # checkpoint dir exists) durable, so --resume continues from
+            # exactly here.
+            from photon_tpu.utils.shutdown import (
+                GracefulShutdown,
+                shutdown_requested,
+            )
+
+            signum = shutdown_requested()
+            if signum is not None:
+                if checkpoint_dir is not None and not saved:
+                    _save_checkpoint()
+                logger.warning(
+                    "coordinate descent stopping after pass %d on signal %d",
+                    it, signum,
                 )
+                raise GracefulShutdown(signum)
 
         final = GameModel(dict(models))
         if best_model is None:

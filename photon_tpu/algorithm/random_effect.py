@@ -47,6 +47,7 @@ from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.ops.variance import coefficient_variances, normalize_variance_type
 from photon_tpu.optim.common import (
     OptimizerConfig,
+    REASON_DIVERGED,
     REASON_FUNCTION_VALUES_CONVERGED,
     REASON_GRADIENT_CONVERGED,
     REASON_MAX_ITERATIONS,
@@ -58,6 +59,7 @@ from photon_tpu.optim.tron import minimize_tron
 from photon_tpu.optim.owlqn import minimize_owlqn
 from photon_tpu.optim.factory import OptimizerSpec
 from photon_tpu.types import OptimizerType, TaskType, VarianceComputationType
+from photon_tpu.utils import faults
 
 Array = jax.Array
 
@@ -107,6 +109,12 @@ class RandomEffectTrackerStats:
         return int(jnp.sum((self.reasons == REASON_MAX_ITERATIONS) & self.valid))
 
     @property
+    def num_quarantined(self) -> int:
+        """Entities whose solve diverged and kept their previous coefficients
+        (the in-trace guard in solve_cache.block_solver)."""
+        return int(jnp.sum((self.reasons == REASON_DIVERGED) & self.valid))
+
+    @property
     def mean_iterations(self) -> float:
         n = jnp.maximum(jnp.sum(self.valid), 1)
         return float(
@@ -123,8 +131,8 @@ class RandomEffectTrackerStats:
     def summary(self) -> str:
         return (
             f"entities={self.num_entities} converged={self.num_converged} "
-            f"hit_max_iter={self.num_max_iter} iters(mean={self.mean_iterations:.1f}, "
-            f"max={self.max_iterations})"
+            f"hit_max_iter={self.num_max_iter} quarantined={self.num_quarantined} "
+            f"iters(mean={self.mean_iterations:.1f}, max={self.max_iterations})"
         )
 
     def diagnostics_dict(self) -> dict:
@@ -135,6 +143,7 @@ class RandomEffectTrackerStats:
             entities=self.num_entities,
             converged=self.num_converged,
             hit_max_iter=self.num_max_iter,
+            quarantined=self.num_quarantined,
             mean_iterations=self.mean_iterations,
             max_iterations=self.max_iterations,
         )
@@ -406,10 +415,12 @@ class RandomEffectCoordinate(Coordinate):
 
     def _reset_active_set(self) -> None:
         self._cd_pass = 0
-        # [(device bool mask, src_block, src_row)] from the LAST dispatch —
-        # src maps route each mask row back to (original block, row).
+        # [(device active mask, device quarantined mask, src_block, src_row)]
+        # from the LAST dispatch — src maps route each mask row back to
+        # (original block, row).
         self._pending_masks: Optional[list] = None
         self.last_active_set_stats: Optional[dict] = None
+        self._fetched_quarantined = 0
 
     def begin_cd_pass(self, cd_iteration: int) -> None:
         """Pass-boundary hook, called by CoordinateDescent before this
@@ -419,19 +430,71 @@ class RandomEffectCoordinate(Coordinate):
         if cd_iteration == 0:
             self._reset_active_set()
 
-    def _fetch_active_masks(self) -> List[np.ndarray]:
+    def export_active_state(self) -> Optional[dict]:
+        """Checkpointable snapshot of the active-set gate: the CD pass
+        counter plus the RESOLVED per-block keep masks (host bool arrays).
+        Called by CoordinateDescent at a pass-boundary checkpoint — the
+        checkpoint write itself materializes every device array, so reading
+        the masks here costs nothing extra. None when there is no gate state
+        (active_set off, or no pass dispatched yet)."""
+        if not self.active_set or self._pending_masks is None:
+            return None
+        keep = self._fetch_active_masks(count_quarantined=False)
+        return dict(
+            cd_pass=int(self._cd_pass),
+            keep=[np.asarray(k) for k in keep],
+        )
+
+    def restore_active_state(self, state: Optional[dict]) -> None:
+        """Inverse of :meth:`export_active_state`: reinstall the keep masks
+        as identity-mapped pending entries so the first resumed pass is
+        gated exactly like the pass the checkpoint interrupted would have
+        been — a resume neither re-solves converged entities nor loses
+        quarantine/retirement decisions."""
+        self._reset_active_set()
+        if not self.active_set or state is None:
+            return
+        self._cd_pass = int(state["cd_pass"])
+        pending = []
+        for i, k in enumerate(state["keep"]):
+            k = np.asarray(k, bool)
+            valid = self._block_valid_rows[i]
+            sb = np.where(valid, i, -1).astype(np.int32)
+            sr = np.where(
+                valid, np.arange(k.shape[0], dtype=np.int32), -1
+            ).astype(np.int32)
+            pending.append((k, np.zeros(k.shape, bool), sb, sr))
+        self._pending_masks = pending
+
+    def _fetch_active_masks(self, count_quarantined: bool = True) -> List[np.ndarray]:
         """HOST fetch of the per-entity active masks the PREVIOUS pass
         computed in-graph — the one opt-in sync of the active-set path. The
         (E,) bool arrays were materialized a full CD pass ago, so the fetch
         does not stall the dispatch pipeline. Entities of blocks that were
         not dispatched last pass have no mask entry and stay retired (the
-        active set shrinks monotonically within a descent)."""
+        active set shrinks monotonically within a descent).
+
+        Divergence-quarantine counts piggyback on this same fetch (the masks
+        travel together from the same dispatch), so the guards add no host
+        syncs of their own."""
         active = [np.zeros((b.num_entities,), bool) for b in self.dataset.blocks]
+        quarantined = 0
         with span("re_mask_fetch"):
-            for mask_dev, sb, sr in self._pending_masks:
-                m = np.asarray(mask_dev) & (sr >= 0)
+            for mask_dev, quar_dev, sb, sr in self._pending_masks:
+                valid = sr >= 0
+                m = np.asarray(mask_dev) & valid
                 for b in np.unique(sb[m]):
                     active[b][sr[m & (sb == b)]] = True
+                if count_quarantined:
+                    quarantined += int(np.sum(np.asarray(quar_dev) & valid))
+        if count_quarantined:
+            self._fetched_quarantined = quarantined
+            if quarantined:
+                from photon_tpu.obs.metrics import registry
+
+                registry().counter(
+                    "re_entities_quarantined", coordinate=self.coordinate_id
+                ).inc(quarantined)
         return active
 
     def _compact_feature_mask(self, idxs, sb_local, sr, block_c):
@@ -528,6 +591,7 @@ class RandomEffectCoordinate(Coordinate):
             entities_total=total,
             entities_active=dispatched_valid,
             entities_skipped=skipped,
+            entities_quarantined=self._fetched_quarantined,
             dispatched_blocks=num_dispatches,
             dispatched_entity_alloc=dispatched_alloc,
             full_entity_alloc=full_alloc,
@@ -585,7 +649,9 @@ class RandomEffectCoordinate(Coordinate):
         pending = []
         with span("re_dispatch_blocks"):
             for block, obj, mask, sb, sr in entries:
-                offs = block.gather_offsets(total_offset)
+                offs = faults.poison(
+                    "solve.re_block", block.gather_offsets(total_offset)
+                )
                 w0 = self._dense_warm_start(coefs, block, d)
                 solver = self.solve_cache.block_solver(
                     obj, self.optimizer_spec, self._config,
@@ -603,8 +669,8 @@ class RandomEffectCoordinate(Coordinate):
                 else:
                     out = solver(block, offs, w0, mask)
                 if tol is not None:
-                    w, iters, reasons, act = out
-                    pending.append((act, sb, sr))
+                    w, iters, reasons, act, quar = out
+                    pending.append((act, quar, sb, sr))
                 else:
                     w, iters, reasons = out
                 results.append((block, w, iters, reasons))
@@ -685,7 +751,9 @@ class RandomEffectCoordinate(Coordinate):
         # dependent work (variances) touches the outputs.
         with span("re_dispatch_blocks"):
             for i, block in enumerate(self.dataset.blocks):
-                offs = block.gather_offsets(total_offset)
+                offs = faults.poison(
+                    "solve.re_block", block.gather_offsets(total_offset)
+                )
                 col_maps.append(block.col_map)
                 block_offs.append(offs)
                 if gated and not keep[i].any():
@@ -711,10 +779,11 @@ class RandomEffectCoordinate(Coordinate):
                 else:
                     out = solver(block, offs, w0, mask)
                 if tol is not None:
-                    w_new, iters, reasons, act = out
+                    w_new, iters, reasons, act, quar = out
                     pending.append(
                         (
                             act,
+                            quar,
                             np.full((block.num_entities,), i, np.int32),
                             np.arange(block.num_entities, dtype=np.int32),
                         )

@@ -231,6 +231,13 @@ class SolveCache:
         ungated dispatches never share (or invalidate) an executable;
         ``trace_keys`` keeps the same shape-only format either way so trace
         breakdowns of gated and ungated runs stay comparable.
+
+        Every dispatch carries an in-trace divergence quarantine: entity rows
+        whose solve produced non-finite coefficients keep their warm start
+        and are flagged ``REASON_DIVERGED``; with ``convergence_tol`` the
+        program additionally returns a per-entity ``quarantined`` bool mask
+        (fifth output) that the coordinate reads at the existing
+        pass-boundary mask fetch — no extra host syncs.
         """
         has_mask = bool(has_mask)
         tol = None if convergence_tol is None else float(convergence_tol)
@@ -245,6 +252,7 @@ class SolveCache:
 
         def build():
             from photon_tpu.algorithm.random_effect import _solve_block
+            from photon_tpu.optim.common import REASON_DIVERGED
 
             stats = self.stats
 
@@ -253,21 +261,35 @@ class SolveCache:
                 stats.trace_keys.append(
                     ("block",) + tuple(block.features.shape) + (has_mask,)
                 )
-                out = _solve_block(
+                w, iterations, reasons = _solve_block(
                     block, offsets, w0, objective, spec, config, feature_mask
                 )
+                # Per-entity divergence quarantine, fully in-trace: a row
+                # whose solve went non-finite keeps its warm start and is
+                # flagged REASON_DIVERGED. The reasons array is only read on
+                # the host at the pass-boundary mask fetch / report finalize,
+                # so the guard adds no syncs.
+                row_finite = jnp.all(jnp.isfinite(w), axis=-1)
+                w = jnp.where(row_finite[:, None], w, w0)
+                reasons = jnp.where(row_finite, reasons, REASON_DIVERGED)
                 if tol is None:
-                    return out
-                w, iterations, reasons = out
+                    return w, iterations, reasons
                 # Relative coefficient movement in MODEL space; the floor of
                 # 1.0 on the reference norm makes near-zero models behave
-                # like an absolute tolerance.
+                # like an absolute tolerance. Quarantined rows have w == w0,
+                # hence delta == 0: they retire from the active set.
                 delta = jnp.linalg.norm((w - w0).astype(jnp.float32), axis=-1)
                 ref = jnp.maximum(
                     jnp.linalg.norm(w0.astype(jnp.float32), axis=-1), 1.0
                 )
-                active = (delta > tol * ref) & (block.entity_idx >= 0)
-                return w, iterations, reasons, active
+                valid = block.entity_idx >= 0
+                active = (delta > tol * ref) & valid
+                # Quarantine keys on the DIVERGED reason, not row_finite:
+                # the in-loop guards (Newton's non-finite-objective stop,
+                # L-BFGS's iterate rollback) already return a finite w while
+                # flagging the row — those entities must still be counted.
+                quarantined = (reasons == REASON_DIVERGED) & valid
+                return w, iterations, reasons, active, quarantined
 
             if has_mask:
 
@@ -301,6 +323,7 @@ class SolveCache:
         key = ("fe", self._objective_key(objective), self._spec_key(spec))
 
         def build():
+            from photon_tpu.optim.common import REASON_DIVERGED
             from photon_tpu.optim.factory import make_optimizer
 
             solve = make_optimizer(objective, spec)
@@ -309,7 +332,19 @@ class SolveCache:
             def traced(w0, lb):
                 stats.traces += 1
                 stats.trace_keys.append(("fe", int(w0.shape[0])))
-                return solve(w0, lb)
+                res = solve(w0, lb)
+                # Divergence backstop covering every optimizer type: a
+                # non-finite final point falls back to the warm start and is
+                # flagged DIVERGED (L-BFGS additionally rolls back to the
+                # last finite iterate inside its own loop).
+                ok = jnp.all(jnp.isfinite(res.w))
+                return dataclasses.replace(
+                    res,
+                    w=jnp.where(ok, res.w, w0),
+                    reason_code=jnp.where(
+                        ok, res.reason_code, jnp.int32(REASON_DIVERGED)
+                    ),
+                )
 
             return jax.jit(traced)
 

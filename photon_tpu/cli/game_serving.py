@@ -29,6 +29,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -66,8 +67,72 @@ def build_parser() -> argparse.ArgumentParser:
                         "expired requests fail 504 without scorer time")
     p.add_argument("--telemetry-out", default=None,
                    help="write the unified run report JSONL here on shutdown")
+    p.add_argument("--reload-poll-interval", type=float, default=0.0,
+                   help="seconds between checks of the model dir for a new "
+                        "generation (a LATEST pointer file naming a subdir, "
+                        "or a rewritten model-metadata.json); a change "
+                        "triggers a zero-downtime reload. 0 disables — "
+                        "reloads then happen only via POST /v1/reload")
     p.add_argument("--verbose", action="store_true")
     return p
+
+
+def resolve_model_dir(model_dir: str) -> str:
+    """Follow a ``LATEST`` pointer file when present: its content names the
+    current generation (a subdirectory of ``model_dir``, or an absolute
+    path). Without one, ``model_dir`` itself is the generation — its
+    metadata mtime is the change signal."""
+    p = os.path.join(model_dir, "LATEST")
+    if os.path.isfile(p):
+        try:
+            with open(p) as f:
+                name = f.read().strip()
+        except OSError:
+            return model_dir
+        if name:
+            cand = name if os.path.isabs(name) else os.path.join(model_dir, name)
+            if os.path.isdir(cand):
+                return cand
+    return model_dir
+
+
+def _model_fingerprint(directory: str):
+    from photon_tpu.io.model_io import METADATA_FILE
+
+    try:
+        mtime = os.path.getmtime(os.path.join(directory, METADATA_FILE))
+    except OSError:
+        mtime = None
+    return (directory, mtime)
+
+
+def _reload_watcher(engine, model_dir: str, interval: float,
+                    stop: threading.Event) -> None:
+    """Poll ``model_dir`` for a new generation and hot-swap it in. A failed
+    reload keeps the current model serving (engine guarantee) and is NOT
+    retried until the fingerprint changes again — one attempt per published
+    generation, no hot-loop on a broken publish."""
+    from photon_tpu.io.model_io import load_game_model
+
+    current = _model_fingerprint(resolve_model_dir(model_dir))
+    while not stop.wait(interval):
+        target = resolve_model_dir(model_dir)
+        fp = _model_fingerprint(target)
+        if fp == current:
+            continue
+        try:
+            logger.info("model change detected: reloading from %s", target)
+            model = load_game_model(
+                target, engine._index_maps, engine._entity_indexes,
+                to_device=False,
+            )
+            engine.reload(model, model_version=target)
+        except Exception as exc:  # noqa: BLE001 — old model keeps serving
+            logger.warning(
+                "auto-reload from %s failed (%s); model %r keeps serving",
+                target, exc, engine.model_version,
+            )
+        current = fp
 
 
 def _request_from_json(obj: dict) -> ScoreRequest:
@@ -213,6 +278,13 @@ def run(args):
 
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
+    if args.reload_poll_interval and args.reload_poll_interval > 0:
+        threading.Thread(
+            target=_reload_watcher,
+            args=(engine, args.model_input_dir, args.reload_poll_interval, stop),
+            name="model-reload-watcher",
+            daemon=True,
+        ).start()
     print(json.dumps({
         "serving": True,
         "host": server.server_address[0],

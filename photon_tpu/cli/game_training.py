@@ -129,6 +129,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "automatically when state exists)")
     p.add_argument("--checkpoint-every", type=int, default=1,
                    help="checkpoint cadence in CD iterations")
+    p.add_argument("--resume", action="store_true",
+                   help="resume an interrupted run from --checkpoint-dir: "
+                        "requires checkpoint state to exist (auto-resume "
+                        "merely uses it when present) and keeps the "
+                        "existing --output-dir instead of failing on it")
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="dotted paths of event listener callables "
                         "(Driver.scala:99-108 registration role)")
@@ -200,7 +205,30 @@ def run(args) -> Dict:
     column_names = parse_input_column_names(
         getattr(args, "input_column_names", None)
     )
-    process_output_dir(args.output_dir, args.override_output_dir)
+    if args.resume:
+        # Explicit resume: checkpoint state must exist (a typo'd dir must
+        # not silently start over), and the half-written output dir of the
+        # interrupted run is expected — keep it (override would DELETE it,
+        # and the checkpoint dir often lives inside).
+        from photon_tpu.utils.checkpoint import latest_step
+
+        if not args.checkpoint_dir:
+            raise SystemExit("--resume requires --checkpoint-dir")
+        # The estimator checkpoints each sweep config under cfg_<i>/; state
+        # in ANY of them (or directly in the dir, for older layouts) counts.
+        cfg_dirs = [args.checkpoint_dir] + sorted(
+            os.path.join(args.checkpoint_dir, d)
+            for d in (os.listdir(args.checkpoint_dir)
+                      if os.path.isdir(args.checkpoint_dir) else [])
+            if d.startswith("cfg_")
+        )
+        if all(latest_step(d) is None for d in cfg_dirs):
+            raise SystemExit(
+                f"--resume: no checkpoint state under {args.checkpoint_dir}"
+            )
+        os.makedirs(args.output_dir, exist_ok=True)
+    else:
+        process_output_dir(args.output_dir, args.override_output_dir)
 
     # Pre-built index maps (feature-indexing driver output; reference
     # offHeapIndexMapDir role). Mandatory for streaming ingest — a stream
@@ -371,15 +399,27 @@ def run(args) -> Dict:
             task=task.value, coordinates=list(update_sequence)
         )
     )
-    results = estimator.fit(
-        batch,
-        validation_batch=valid_batch,
-        evaluation_suite=suite if valid_batch is not None else None,
-        initial_model=warm,
-        checkpoint_dir=args.checkpoint_dir,
-        checkpoint_every=args.checkpoint_every,
-        emitter=emitter,
-    )
+    from photon_tpu.utils.shutdown import GracefulShutdown, handle_termination
+
+    try:
+        with handle_termination():
+            results = estimator.fit(
+                batch,
+                validation_batch=valid_batch,
+                evaluation_suite=suite if valid_batch is not None else None,
+                initial_model=warm,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                emitter=emitter,
+            )
+    except GracefulShutdown as exc:
+        # The CD loop already wrote a final pass-boundary checkpoint;
+        # finalize telemetry so the interrupted run still reports, then
+        # exit with the conventional killed-by-signal code.
+        finalize_run_report(
+            "game_training", path=args.telemetry_out, emitter=emitter
+        )
+        raise SystemExit(128 + exc.signum) from exc
 
     # --- hyperparameter auto-tuning (runHyperparameterTuning role,
     # reference GameTrainingDriver.scala:651-692) ---

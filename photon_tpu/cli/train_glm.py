@@ -156,6 +156,16 @@ def build_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.common import add_active_set_args
 
     add_active_set_args(p)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="λ-sweep checkpoint/resume directory: one durable "
+                        "step per completed λ (results + the warm-start "
+                        "vector), written through the atomic checkpoint "
+                        "machinery; a killed run resumes at the next λ. "
+                        "Resumes automatically when state exists")
+    p.add_argument("--resume", action="store_true",
+                   help="resume an interrupted λ sweep from --checkpoint-dir "
+                        "(requires checkpoint state to exist; auto-resume "
+                        "merely uses it when present)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -363,12 +373,74 @@ def run(args) -> Dict:
     emitter.emit(training_start_event(task=task.value, weights=weights))
 
     from photon_tpu.algorithm.solve_cache import default_cache
+    from photon_tpu.utils.shutdown import (
+        GracefulShutdown,
+        handle_termination,
+        shutdown_requested,
+    )
 
     models: List[Dict] = []
     solver_diags: List = []
     solver_walls: List[float] = []
     w = jnp.zeros((train.dim,), jnp.float32)
-    for lam in weights:
+
+    # λ-sweep checkpoint/resume: one step per completed λ through the atomic
+    # checkpoint machinery (utils/checkpoint.py). The tag pins the sweep
+    # configuration — a resumed run must be solving the SAME problem, or the
+    # restored warm-start chain would silently change the results.
+    ckpt_dir = args.checkpoint_dir
+    ckpt_tag = "|".join([
+        args.task, args.optimizer, f"{args.elastic_net_alpha:g}",
+        ",".join(f"{lam:g}" for lam in weights),
+    ])
+    start_idx = 0
+    if ckpt_dir and args.validate_per_iteration:
+        raise ValueError(
+            "--checkpoint-dir is incompatible with --validate-per-iteration "
+            "(per-iteration replay handles are not persistable)"
+        )
+    if args.resume and not ckpt_dir:
+        raise ValueError("--resume requires --checkpoint-dir")
+    if ckpt_dir:
+        from photon_tpu.utils.checkpoint import (
+            LegacyCheckpointError,
+            latest_step,
+            load_checkpoint,
+        )
+
+        if args.resume and latest_step(ckpt_dir) is None:
+            raise ValueError(f"--resume: no checkpoint state under {ckpt_dir}")
+        log = logging.getLogger("photon_tpu.train_glm")
+        state = step = None
+        try:
+            state, step = load_checkpoint(ckpt_dir)
+        except FileNotFoundError:
+            pass
+        except LegacyCheckpointError as exc:
+            log.warning("ignoring legacy checkpoint under %s: %s", ckpt_dir, exc)
+        if state is not None:
+            if state.get("tag") != ckpt_tag:
+                log.warning(
+                    "checkpoint under %s is for a different λ-sweep "
+                    "configuration; starting fresh", ckpt_dir,
+                )
+            else:
+                models = list(state["models"])
+                solver_diags = list(state["solver_diags"])
+                solver_walls = list(state["solver_walls"])
+                w = state["w"]
+                start_idx = step + 1
+                log.info(
+                    "resuming λ sweep from checkpoint: %d/%d weights done",
+                    start_idx, len(weights),
+                )
+                from photon_tpu.obs import registry as _registry
+
+                _registry().counter("glm_sweep_resumes_total").inc()
+
+    for lam_idx, lam in enumerate(weights):
+        if lam_idx < start_idx:
+            continue  # restored from checkpoint
         objective = GLMObjective(
             loss=loss,
             l2_weight=(1.0 - args.elastic_net_alpha) * lam,
@@ -423,6 +495,35 @@ def run(args) -> Dict:
                 convergence=result.convergence_reason.value,
             )
         )
+        if ckpt_dir:
+            from photon_tpu.utils.checkpoint import save_checkpoint
+
+            # Replay handles (_objective/_spec/_w0) are live closures, not
+            # persistable — strip them; everything else (including the
+            # OptimizeResult diagnostics) round-trips through the manifest.
+            save_checkpoint(
+                ckpt_dir,
+                dict(
+                    tag=ckpt_tag,
+                    w=w,
+                    models=[
+                        {k: v for k, v in m.items() if not k.startswith("_")}
+                        for m in models
+                    ],
+                    solver_diags=solver_diags,
+                    solver_walls=solver_walls,
+                ),
+                lam_idx,
+            )
+        signum = shutdown_requested()
+        if signum is not None:
+            logging.getLogger("photon_tpu.train_glm").warning(
+                "λ sweep stopping after λ=%g on signal %d", lam, signum
+            )
+            finalize_run_report(
+                "train_glm", path=args.telemetry_out, emitter=emitter
+            )
+            raise GracefulShutdown(signum)
     stage = DriverStage.TRAINED
 
     # Validation + model selection (Driver.computeAndLogModelMetrics:353 +
@@ -532,7 +633,15 @@ def run(args) -> Dict:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    summary = run(args)
+    from photon_tpu.utils.shutdown import GracefulShutdown, handle_termination
+
+    try:
+        with handle_termination():
+            summary = run(args)
+    except GracefulShutdown as exc:
+        # Telemetry was finalized and the last completed λ is durable in
+        # --checkpoint-dir; 128+signum is the conventional signal exit.
+        raise SystemExit(128 + exc.signum) from exc
     print(json.dumps({"best_lambda": summary["best_lambda"]}))
 
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import queue
 import threading
 import time
@@ -52,6 +53,7 @@ import numpy as np
 
 from photon_tpu.obs.metrics import registry
 from photon_tpu.obs.trace import current_span_path, record_span, tracer
+from photon_tpu.utils import faults
 from photon_tpu.utils.timed import PipelineStats, StageStats, record_pipeline
 
 logger = logging.getLogger("photon_tpu")
@@ -63,6 +65,121 @@ logger = logging.getLogger("photon_tpu")
 DEFAULT_QUEUE_DEPTH = 2
 
 _DONE = object()
+_SKIP = object()  # _retry_or_skip verdict: drop this chunk, keep streaming
+
+# Errors worth retrying: filesystem/network hiccups and injected transients
+# (faults.TransientInjectedFault subclasses OSError on purpose). Everything
+# else — decode logic errors, assembly bugs — fails fast into the skip
+# budget or the consumer.
+TRANSIENT_ERRORS = (OSError, TimeoutError)
+
+MAX_RETRIES_ENV = "PHOTON_TPU_PIPELINE_MAX_RETRIES"
+SKIP_BUDGET_ENV = "PHOTON_TPU_PIPELINE_SKIP_BUDGET"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Transient-failure handling for pipeline stages: exponential backoff
+    with deterministic seeded jitter on ``TRANSIENT_ERRORS``, then a bounded
+    poisoned-chunk skip budget SHARED across all stages of one pipeline run.
+    ``skip_budget=0`` (default) keeps the historical fail-fast behavior."""
+
+    max_retries: int = 2
+    backoff_s: float = 0.05
+    backoff_max_s: float = 2.0
+    jitter: float = 0.25
+    skip_budget: int = 0
+    seed: int = 0
+
+
+def default_retry_policy() -> RetryPolicy:
+    """Env-tunable default (drivers that expose no retry flags still get
+    operational knobs): PHOTON_TPU_PIPELINE_MAX_RETRIES / _SKIP_BUDGET."""
+    p = RetryPolicy()
+    mr = os.environ.get(MAX_RETRIES_ENV, "").strip()
+    sb = os.environ.get(SKIP_BUDGET_ENV, "").strip()
+    if mr:
+        p = dataclasses.replace(p, max_retries=int(mr))
+    if sb:
+        p = dataclasses.replace(p, skip_budget=int(sb))
+    return p
+
+
+class _SkipBudget:
+    """Pipeline-wide poisoned-chunk budget (thread-safe)."""
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self.used = 0
+        self._lock = threading.Lock()
+
+    def try_consume(self) -> bool:
+        with self._lock:
+            if self.used >= self.limit:
+                return False
+            self.used += 1
+            return True
+
+
+def _with_retries(
+    fn: Callable,
+    item,
+    policy: RetryPolicy,
+    name: str,
+    stop: Optional[threading.Event],
+    rng: np.random.Generator,
+):
+    """Call ``fn(item)`` retrying TRANSIENT_ERRORS with jittered exponential
+    backoff. The backoff wait respects the stop event so a shutting-down
+    pipeline never sits out a sleep (the no-hang guarantee)."""
+    delay = policy.backoff_s
+    attempt = 0
+    while True:
+        try:
+            return fn(item)
+        except TRANSIENT_ERRORS as exc:
+            attempt += 1
+            if attempt > policy.max_retries:
+                raise
+            sleep = delay * (1.0 + policy.jitter * float(rng.random()))
+            registry().counter("pipeline_retries_total", stage=name).inc()
+            logger.warning(
+                "pipeline stage %s: transient failure (attempt %d/%d), "
+                "retrying in %.3fs: %s",
+                name, attempt, policy.max_retries, sleep, exc,
+            )
+            if stop is not None:
+                if stop.wait(sleep):
+                    raise  # shutting down — abandon remaining retries
+            else:
+                time.sleep(sleep)
+            delay = min(delay * 2.0, policy.backoff_max_s)
+
+
+def _retry_or_skip(
+    fn: Callable,
+    item,
+    policy: RetryPolicy,
+    name: str,
+    stop: Optional[threading.Event],
+    rng: np.random.Generator,
+    skips: _SkipBudget,
+):
+    """Retry layer + skip budget: a chunk whose processing keeps failing is
+    DROPPED (returning ``_SKIP``) while budget remains, else the error
+    propagates (→ ``_Failure`` → the consumer raises)."""
+    try:
+        return _with_retries(fn, item, policy, name, stop, rng)
+    except Exception as exc:  # noqa: BLE001 — budget decision, then re-raise
+        if skips.try_consume():
+            registry().counter("pipeline_chunks_skipped_total", stage=name).inc()
+            logger.warning(
+                "pipeline stage %s: skipping poisoned chunk after retries "
+                "(%s); skip budget %d/%d used",
+                name, exc, skips.used, skips.limit,
+            )
+            return _SKIP
+        raise
 
 
 def default_decode_workers() -> int:
@@ -142,6 +259,11 @@ def _source_thread(
     stage: StageStats,
     stop: threading.Event,
     nbytes_of: Callable,
+    name: str,
+    source_hook: Optional[Callable],
+    policy: RetryPolicy,
+    rng: np.random.Generator,
+    skips: _SkipBudget,
 ) -> None:
     gen = None
     try:
@@ -152,6 +274,16 @@ def _source_thread(
                 item = next(gen)
             except StopIteration:
                 break
+            if source_hook is not None:
+                # Per-chunk hook (fault injection / validation) runs OUTSIDE
+                # next(): a retry re-runs only the hook — a generator that
+                # raised cannot be resumed, so errors inside the source
+                # itself stay permanent (forwarded below).
+                item = _retry_or_skip(
+                    source_hook, item, policy, name, stop, rng, skips
+                )
+                if item is _SKIP:
+                    continue
             stage.add_busy(time.perf_counter() - t0, nbytes_of(item))
             t1 = time.perf_counter()
             if not _put(out_q, item, stop):
@@ -176,6 +308,10 @@ def _stage_thread(
     stage: StageStats,
     stop: threading.Event,
     nbytes_of: Callable,
+    name: str,
+    policy: RetryPolicy,
+    rng: np.random.Generator,
+    skips: _SkipBudget,
 ) -> None:
     try:
         while True:
@@ -189,7 +325,9 @@ def _stage_thread(
                 _put(out_q, item, stop)
                 return
             t1 = time.perf_counter()
-            out = fn(item)
+            out = _retry_or_skip(fn, item, policy, name, stop, rng, skips)
+            if out is _SKIP:
+                continue
             stage.add_busy(time.perf_counter() - t1, nbytes_of(out))
             t2 = time.perf_counter()
             if not _put(out_q, out, stop):
@@ -208,26 +346,56 @@ def _run_staged(
     depth: int,
     overlap: bool,
     source_name: str = "decode",
+    retry: Optional[RetryPolicy] = None,
+    source_hook: Optional[Callable] = None,
 ) -> Iterator:
     """Compose source + transform stages into one output iterator, threaded
-    (bounded queues) or inline — same functions, same order, same results."""
+    (bounded queues) or inline — same functions, same order, same results.
+    ``retry`` adds transient-error backoff and a shared poisoned-chunk skip
+    budget to every stage (and ``source_hook``, run per item after the
+    source yields it); both paths apply identical retry/skip semantics."""
+    policy = retry if retry is not None else default_retry_policy()
+    skips = _SkipBudget(policy.skip_budget)
+    # Per-stage RNGs so jitter streams are independent yet deterministic
+    # for a fixed policy.seed regardless of thread interleaving.
+    src_rng = np.random.default_rng(policy.seed)
+    stage_rngs = [np.random.default_rng(policy.seed + i + 1) for i in range(len(stages))]
+
     if not overlap:
         src_stage = stats.stage(source_name)
-        stage_objs = [(stats.stage(name), fn, nb) for name, fn, nb in stages]
+        stage_objs = [
+            (stats.stage(name), fn, nb, stage_rngs[i])
+            for i, (name, fn, nb) in enumerate(stages)
+        ]
         gen = make_source()
         try:
             for item in gen:
+                if source_hook is not None:
+                    item = _retry_or_skip(
+                        source_hook, item, policy, source_name, None, src_rng, skips
+                    )
+                    if item is _SKIP:
+                        continue
                 src_stage.add_busy(0.0, source_nbytes(item))
                 # busy time for the source is folded into the consumer's
                 # iteration in serial mode; per-stage transform walls are
                 # still measured so the A/B can compare stage costs.
-                for stage, fn, nb in stage_objs:
+                skipped = False
+                for stage, fn, nb, rng in stage_objs:
                     t0 = time.perf_counter()
-                    item = fn(item)
+                    item = _retry_or_skip(
+                        fn, item, policy, stage.name, None, rng, skips
+                    )
+                    if item is _SKIP:
+                        skipped = True
+                        break
                     stage.add_busy(time.perf_counter() - t0, nb(item))
-                yield item
+                if not skipped:
+                    yield item
         finally:
-            gen.close()
+            close = getattr(gen, "close", None)
+            if close is not None:
+                close()
         return
 
     stop = threading.Event()
@@ -251,7 +419,8 @@ def _run_staged(
     threads = [
         threading.Thread(
             target=spanned(_source_thread),
-            args=(make_source, queues[0], stats.stage(source_name), stop, source_nbytes),
+            args=(make_source, queues[0], stats.stage(source_name), stop,
+                  source_nbytes, source_name, source_hook, policy, src_rng, skips),
             name=f"photon-pipe-{source_name}",
             daemon=True,
         )
@@ -260,7 +429,8 @@ def _run_staged(
         threads.append(
             threading.Thread(
                 target=spanned(_stage_thread),
-                args=(fn, queues[i], queues[i + 1], stats.stage(name), stop, nbytes_of),
+                args=(fn, queues[i], queues[i + 1], stats.stage(name), stop,
+                      nbytes_of, name, policy, stage_rngs[i], skips),
                 name=f"photon-pipe-{name}",
                 daemon=True,
             )
@@ -270,7 +440,20 @@ def _run_staged(
     out_q = queues[-1]
     try:
         while True:
-            item = _get(out_q, stop)
+            # No-hang guarantee: a manual timed get so the consumer can
+            # notice every stage thread dying without a _DONE/_Failure
+            # reaching this queue (e.g. a forwarding _put raced shutdown).
+            try:
+                item = out_q.get(timeout=0.05)
+            except queue.Empty:
+                if stop.is_set():
+                    return
+                if not any(t.is_alive() for t in threads) and out_q.empty():
+                    raise RuntimeError(
+                        "pipeline stage threads exited without completing "
+                        "the stream"
+                    )
+                continue
             if item is _DONE:
                 return
             if isinstance(item, _Failure):
@@ -311,6 +494,23 @@ def _h2d(chunk: BatchChunk, pad_rows_to: Optional[int]) -> BatchChunk:
     if pad_rows_to:
         chunk = _bucket_pad_host(chunk, pad_rows_to)
     return BatchChunk(jax.device_put(chunk.batch), chunk.n, chunk.index)
+
+
+def _faulted(site: str, fn: Callable) -> Callable:
+    """Prefix a stage function with a fault-injection checkpoint. The check
+    runs BEFORE fn, so an injected transient retries the whole stage call
+    on the same (unconsumed) item."""
+
+    def wrapped(item):
+        faults.check(site)
+        return fn(item)
+
+    return wrapped
+
+
+def _source_fault_hook(item):
+    faults.check("ingest.source")
+    return item
 
 
 def _make_assembler(
@@ -412,6 +612,7 @@ def stream_device_batches(
     overlap: bool = True,
     telemetry_label: str = "ingest",
     stats: Optional[PipelineStats] = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> Iterator[BatchChunk]:
     """The full pipeline: decode → assemble → h2d, yielding device-resident
     GameBatch chunks the consumer's jitted compute overlaps with.
@@ -425,6 +626,11 @@ def stream_device_batches(
     functions run inline on the consumer thread — bit-identical chunks,
     no threads. Telemetry lands in utils/timed.py under
     ``telemetry_label`` either way.
+
+    ``retry`` (default :func:`default_retry_policy`) governs transient-error
+    backoff per stage plus a shared poisoned-chunk skip budget. Assemble
+    retries are safe: the assembler mutates its interning/uid state only
+    after a chunk fully assembles.
     """
     from photon_tpu.io.columnar import stream_avro_columnar
     from photon_tpu.io.data_reader import _expand_paths
@@ -445,13 +651,15 @@ def stream_device_batches(
         return stream_avro_columnar(expanded, chunk_rows, workers=decode_workers)
 
     stages = [
-        ("assemble", assemble, chunk_nbytes),
-        ("h2d", lambda c: _h2d(c, pad_rows_to), lambda c: 0),
+        ("assemble", _faulted("ingest.assemble", assemble), chunk_nbytes),
+        ("h2d", _faulted("ingest.h2d", lambda c: _h2d(c, pad_rows_to)), lambda c: 0),
     ]
+    source_hook = _source_fault_hook if faults.active("ingest.source") else None
     t0 = time.perf_counter()
     try:
         yield from _run_staged(
-            source, columnar_nbytes, stages, stats, depth, overlap
+            source, columnar_nbytes, stages, stats, depth, overlap,
+            retry=retry, source_hook=source_hook,
         )
     finally:
         stats.wall_s = time.perf_counter() - t0
@@ -478,6 +686,7 @@ def device_chunks_from(
     overlap: bool = True,
     telemetry_label: str = "replay",
     stats: Optional[PipelineStats] = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> Iterator[BatchChunk]:
     """Run only the h2d stage over an existing host-chunk source (a replay
     cache pass): placement overlaps compute, decode/assembly already paid."""
@@ -486,12 +695,12 @@ def device_chunks_from(
     else:
         stats.overlapped = overlap
     record_pipeline(telemetry_label, stats)
-    stages = [("h2d", lambda c: _h2d(c, pad_rows_to), lambda c: 0)]
+    stages = [("h2d", _faulted("ingest.h2d", lambda c: _h2d(c, pad_rows_to)), lambda c: 0)]
     t0 = time.perf_counter()
     try:
         yield from _run_staged(
             host_chunks, chunk_nbytes, stages, stats, depth, overlap,
-            source_name="assemble",
+            source_name="assemble", retry=retry,
         )
     finally:
         stats.wall_s = time.perf_counter() - t0

@@ -29,6 +29,10 @@ REASON_MAX_ITERATIONS = 1
 REASON_FUNCTION_VALUES_CONVERGED = 2
 REASON_GRADIENT_CONVERGED = 3
 REASON_OBJECTIVE_NOT_IMPROVING = 4
+# The solve produced a non-finite iterate and was rolled back to the last
+# finite point (in-trace divergence guard). Not a convergence state: callers
+# treating DIVERGED results should keep the previous/warm-start coefficients.
+REASON_DIVERGED = 5
 
 _REASONS = {
     REASON_NOT_CONVERGED: ConvergenceReason.NOT_CONVERGED,
@@ -36,6 +40,7 @@ _REASONS = {
     REASON_FUNCTION_VALUES_CONVERGED: ConvergenceReason.FUNCTION_VALUES_CONVERGED,
     REASON_GRADIENT_CONVERGED: ConvergenceReason.GRADIENT_CONVERGED,
     REASON_OBJECTIVE_NOT_IMPROVING: ConvergenceReason.OBJECTIVE_NOT_IMPROVING,
+    REASON_DIVERGED: ConvergenceReason.DIVERGED,
 }
 
 

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from photon_tpu.optim.common import (
     OptimizeResult,
     OptimizerConfig,
+    REASON_DIVERGED,
     REASON_MAX_ITERATIONS,
     REASON_NOT_CONVERGED,
     check_convergence,
@@ -183,6 +184,19 @@ def minimize_lbfgs(
         w_new = proj(w + ls.alpha * p)
         f_new, g_new = value_and_grad(w_new)
 
+        # Divergence rollback: a non-finite trial state (NaN loss from corrupt
+        # data, overflowing step) never replaces the last finite iterate —
+        # keep (w, f, g) and terminate with REASON_DIVERGED. The rollback also
+        # zeroes (s, y) below, so no poisoned curvature pair is stored.
+        finite = (
+            jnp.isfinite(f_new)
+            & jnp.all(jnp.isfinite(w_new))
+            & jnp.all(jnp.isfinite(g_new))
+        )
+        w_new = jnp.where(finite, w_new, w)
+        f_new = jnp.where(finite, f_new, f)
+        g_new = jnp.where(finite, g_new, g)
+
         s = w_new - w
         y = g_new - g
         sy = jnp.dot(s, y)
@@ -200,6 +214,7 @@ def minimize_lbfgs(
         it = st["it"] + 1
         gn = opt_gnorm(w_new, g_new)
         reason = check_convergence(f_new, f, gn, g0_norm, tol, it, max_iter)
+        reason = jnp.where(finite, reason, REASON_DIVERGED)
         # A step that made no progress at all terminates the loop
         # (OBJECTIVE_NOT_IMPROVING analogue handled by fn-values check since
         # |Δf|=0 ⇒ FUNCTION_VALUES_CONVERGED).

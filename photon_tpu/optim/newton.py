@@ -34,6 +34,7 @@ from photon_tpu.ops.objective import GLMObjective
 from photon_tpu.optim.common import (
     OptimizeResult,
     OptimizerConfig,
+    REASON_DIVERGED,
     REASON_MAX_ITERATIONS,
     REASON_NOT_CONVERGED,
     check_convergence,
@@ -188,6 +189,14 @@ def minimize_newton(
         # precisely "can't improve" (a genuinely bad rejected step has a
         # large |f_best − f| and keeps iterating with boosted damping).
         reason = check_convergence(f_best, f, gnorm, g0_norm, tol, it, m_iter)
+        # Divergence guard: a non-finite carried objective can never be
+        # improved (every trial compares False against NaN, so the reject
+        # branch keeps the iterate forever). Flag DIVERGED and stop; the
+        # iterate itself is still the last finite point (w0 when f0 was
+        # already non-finite — e.g. corrupted offsets).
+        reason = jnp.where(
+            jnp.isfinite(f_new), reason, jnp.int32(REASON_DIVERGED)
+        )
         return dict(
             w=w_new,
             z=z_new,

@@ -28,13 +28,24 @@ transformer + warm-up while the old state keeps serving; the swap happens
 under the engine's scoring lock, so in-flight batches drain on the old
 state and the next batch scores on the new one. No request ever observes a
 half-loaded model.
+
+Graceful degradation (ISSUE 6): a reload whose build/warm-up fails leaves
+the OLD state serving (the failure is reported via :class:`ReloadError` and
+``stats()['last_reload_error']``), and each managed RE type carries a
+circuit breaker — repeated ``resolve`` failures trip it, after which that
+type's entity ids resolve to -1 (cold start ⇒ the RE contributes 0, i.e.
+FE-only scoring, on already-compiled program shapes) until a cooldown
+half-opens it. Requests keep answering throughout; ``stats()`` (and the
+HTTP ``/healthz``) report the degraded set.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -49,6 +60,14 @@ from photon_tpu.obs.metrics import registry
 from photon_tpu.obs.trace import tracer
 from photon_tpu.serve.batcher import MicroBatcher, ScoreRequest
 from photon_tpu.serve.store import HotColdEntityStore
+from photon_tpu.utils import faults
+
+logger = logging.getLogger("photon_tpu")
+
+
+class ReloadError(RuntimeError):
+    """A reload failed to build/warm the new model generation. The old
+    generation is still serving — the error is a report, not an outage."""
 
 
 @dataclasses.dataclass
@@ -58,6 +77,41 @@ class ServeConfig:
     queue_cap: int = 1024  # admission bound; beyond it submits shed
     hot_bytes: int = 64 << 20  # device budget for cached RE tables
     default_deadline_ms: Optional[float] = None  # per-request unless given
+    breaker_threshold: int = 3  # consecutive resolve failures to trip
+    breaker_cooldown_s: float = 30.0  # open duration before half-open probe
+
+
+class _Breaker:
+    """Per-RE-type circuit breaker. Single-writer (the engine's batch lock
+    serializes _assemble), so plain fields suffice."""
+
+    def __init__(self, threshold: int, cooldown_s: float):
+        self.threshold = max(int(threshold), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.failures = 0
+        self.open_until = 0.0
+        self.trips = 0
+
+    @property
+    def open(self) -> bool:
+        return time.monotonic() < self.open_until
+
+    def record_failure(self) -> bool:
+        """Count one failure; returns True when this one trips the breaker
+        (reaching the threshold, or failing the half-open probe after a
+        cooldown — that re-trips immediately)."""
+        half_open_probe = self.open_until > 0.0 and not self.open
+        self.failures += 1
+        if half_open_probe or self.failures >= self.threshold:
+            self.open_until = time.monotonic() + self.cooldown_s
+            self.failures = 0
+            self.trips += 1
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.open_until = 0.0
 
 
 @dataclasses.dataclass
@@ -100,6 +154,11 @@ class ServingEngine:
         }
         self._lock = threading.RLock()
         self._reloads = 0
+        self._reload_failures = 0
+        self._last_reload_error: Optional[str] = None
+        # Per-RE-type circuit breakers: engine-owned (they outlive reloads —
+        # a flapping store should stay degraded across a model swap).
+        self._breakers: Dict[str, _Breaker] = {}
         self._state = self._build_state(model, model_version)
         self.batcher = MicroBatcher(
             self._score_batch,
@@ -211,7 +270,7 @@ class ServingEngine:
         entity_ids = {}
         for rt in store.entity_re_types:
             keys = [r.entity_ids.get(rt, -1) for r in requests]
-            entity_ids[rt] = store.resolve(rt, keys)
+            entity_ids[rt] = self._resolve_guarded(store, rt, keys)
         return GameBatch(
             label=np.zeros(n, np.float32),
             offset=np.asarray([r.offset for r in requests], np.float32),
@@ -219,6 +278,52 @@ class ServingEngine:
             features=features,
             entity_ids=entity_ids,
         )
+
+    def _breaker(self, re_type: str) -> _Breaker:
+        b = self._breakers.get(re_type)
+        if b is None:
+            b = self._breakers[re_type] = _Breaker(
+                self.config.breaker_threshold, self.config.breaker_cooldown_s
+            )
+        return b
+
+    def _resolve_guarded(
+        self, store: HotColdEntityStore, re_type: str, keys: List
+    ) -> np.ndarray:
+        """``store.resolve`` behind the RE type's circuit breaker. Open
+        breaker (or a failing resolve) degrades THIS batch's type to all
+        -1 slots — cold-start semantics, so the random effect contributes 0
+        and scoring proceeds FE-only on already-compiled shapes."""
+        breaker = self._breaker(re_type)
+        reg = registry()
+        if breaker.open:
+            reg.counter("serve_requests_degraded_total", re_type=re_type).inc(
+                len(keys)
+            )
+            return np.full(len(keys), -1, np.int32)
+        try:
+            slots = store.resolve(re_type, keys)
+        except Exception as exc:  # noqa: BLE001 — degrade, never crash
+            reg.counter("serve_store_errors_total", re_type=re_type).inc()
+            if breaker.record_failure():
+                reg.counter("serve_breaker_trips_total", re_type=re_type).inc()
+                logger.warning(
+                    "serving: circuit breaker for RE type %r OPEN for "
+                    "%.1fs after resolve failure: %s",
+                    re_type, breaker.cooldown_s, exc,
+                )
+            else:
+                logger.warning(
+                    "serving: resolve failed for RE type %r (%d/%d to "
+                    "breaker trip): %s",
+                    re_type, breaker.failures, breaker.threshold, exc,
+                )
+            reg.counter("serve_requests_degraded_total", re_type=re_type).inc(
+                len(keys)
+            )
+            return np.full(len(keys), -1, np.int32)
+        breaker.record_success()
+        return slots
 
     # -- the batcher's score_fn --------------------------------------------
 
@@ -229,6 +334,7 @@ class ServingEngine:
             state = self._state
             n = len(requests)
             with tracer().span("score"):
+                faults.check("serve.score")
                 batch = self._assemble(requests, state.store)
                 batch = pad_game_batch(batch, bucket_dim(n), xp=np)
                 dev = jax.device_put(batch)
@@ -273,18 +379,39 @@ class ServingEngine:
         """Zero-downtime swap to ``model`` (host-side master). Builds and
         warms the new generation OFF the scoring lock — the old state keeps
         serving — then swaps under it, which also drains the in-flight
-        batch. Returns the new generation's stats."""
+        batch. Returns the new generation's stats.
+
+        A failed build/warm-up raises :class:`ReloadError` and leaves the
+        OLD state serving, untouched — the error is also visible in
+        ``stats()['last_reload_error']`` until a reload succeeds."""
         self._reloads += 1
         version = model_version or f"reload-{self._reloads}"
-        new_state = self._build_state(model, version)  # old state serving
+        try:
+            faults.check("serve.reload")
+            new_state = self._build_state(model, version)  # old state serving
+        except Exception as exc:  # noqa: BLE001 — keep the old model serving
+            self._reload_failures += 1
+            self._last_reload_error = f"{version}: {exc}"
+            registry().counter("serve_reload_failures_total").inc()
+            logger.warning(
+                "serving: reload to %r failed (%s); previous model %r "
+                "keeps serving", version, exc, self._state.model_version,
+            )
+            raise ReloadError(
+                f"reload to {version!r} failed: {exc}"
+            ) from exc
         with tracer().span("serve/reload_swap"):
             with self._lock:
                 self._state = new_state
+        self._last_reload_error = None
         registry().counter("serve_model_reloads_total").inc()
         return dict(model_version=version, store=new_state.store.stats())
 
     def stats(self) -> Dict:
         state = self._state
+        degraded = sorted(
+            rt for rt, b in self._breakers.items() if b.open
+        )
         return dict(
             model_version=state.model_version,
             queue_depth=self.batcher.queue_depth,
@@ -292,6 +419,13 @@ class ServingEngine:
             trace_count=state.transformer.trace_count,
             retraces_since_warmup=self.retraces_since_warmup,
             store=state.store.stats(),
+            degraded=bool(degraded) or self._last_reload_error is not None,
+            degraded_re_types=degraded,
+            breaker_trips={
+                rt: b.trips for rt, b in self._breakers.items() if b.trips
+            },
+            reload_failures=self._reload_failures,
+            last_reload_error=self._last_reload_error,
         )
 
     def close(self, drain: bool = True) -> None:

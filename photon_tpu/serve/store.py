@@ -40,6 +40,7 @@ from photon_tpu.models.game import (
     RandomEffectModel,
 )
 from photon_tpu.obs.metrics import registry
+from photon_tpu.utils import faults
 
 _scatter_rows = None
 
@@ -221,6 +222,7 @@ class HotColdEntityStore:
         promoting misses from the host master. -1 rows (cold start) pass
         through and score 0. Single-writer: the engine's batch lock
         serializes calls."""
+        faults.check("serve.store_resolve", label=re_type)
         group = self._groups.get(re_type)
         if group is None:
             E = self._passthrough.get(re_type)
@@ -289,6 +291,7 @@ class HotColdEntityStore:
     def _upload(self, group: _ReGroup, entities: List[int]) -> None:
         """One bucketed scatter per coordinate: miss count pads up the
         shape grid, filler indices land out of range and drop."""
+        faults.check("serve.store_upload", label=group.re_type)
         m = len(entities)
         m_b = bucket_dim(m)
         idx = np.full(m_b, group.capacity, np.int32)

@@ -37,6 +37,9 @@ class ConvergenceReason(enum.Enum):
     GRADIENT_CONVERGED = "GRADIENT_CONVERGED"
     OBJECTIVE_NOT_IMPROVING = "OBJECTIVE_NOT_IMPROVING"
     NOT_CONVERGED = "NOT_CONVERGED"
+    # Not in the reference: the solve hit a non-finite iterate and was rolled
+    # back to the last finite point (divergence guard, utils/faults.py story).
+    DIVERGED = "DIVERGED"
 
 
 class NormalizationType(enum.Enum):

@@ -30,12 +30,18 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
+import logging
 import os
+import zipfile
 from typing import Any, Dict, Optional, Tuple, Type
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from photon_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
 
 _LATEST = "LATEST"
 _FORMAT_VERSION = 2
@@ -221,6 +227,23 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
     arrays: list = []
     manifest = {"version": _FORMAT_VERSION, "root": _encode(state, arrays)}
     path = os.path.join(directory, f"step_{step}.npz")
+    # Fault hook: a ``torn`` rule simulates a machine crash that published
+    # the rename but not the data blocks — a truncated file at the FINAL
+    # name, which resumable loads must skip (see load_checkpoint). A
+    # ``kill``/error rule fires before anything is written.
+    rule = faults.injector().fire("checkpoint.save")
+    if rule is not None:
+        if rule.kind == "kill":
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+        if rule.kind == "torn":
+            with open(path, "wb") as f:
+                f.write(b"PK\x03\x04torn-checkpoint")
+            raise faults.PermanentInjectedFault(
+                f"injected torn checkpoint at {path}"
+            )
+        raise faults.exception_for(rule, "checkpoint.save")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(
@@ -242,6 +265,9 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(latest_tmp, os.path.join(directory, _LATEST))
+    # Post-publish hook: the ``ci.sh faults`` kill-and-resume smoke SIGKILLs
+    # here, right after a step becomes durable — the worst legitimate moment.
+    faults.check("checkpoint.after_save")
     return path
 
 
@@ -282,14 +308,53 @@ def latest_step(directory: str) -> Optional[int]:
 
 
 def load_checkpoint(directory: str, step: Optional[int] = None) -> Tuple[Any, int]:
-    """Load a checkpoint (latest by default) back into typed objects.
-    Only JSON + numpy arrays are read — no pickle, no code execution."""
+    """Load a checkpoint back into typed objects. Only JSON + numpy arrays
+    are read — no pickle, no code execution.
+
+    With an explicit ``step``, a corrupt file raises (the caller asked for
+    that exact step). With ``step=None`` the load is RESUME-ROBUST: it walks
+    the available steps newest→oldest and skips unreadable ones (truncated
+    npz from a machine crash mid-``save_checkpoint``, missing manifest,
+    shape-mangled leaves) with a warning and a
+    ``checkpoint_corrupt_skipped_total`` count, so a torn newest step never
+    strands the run — it resumes one step earlier. Raises
+    ``FileNotFoundError`` when no step exists, :class:`LegacyCheckpointError`
+    when the only candidates are v1/pickle files, or the last decode error
+    when every candidate is corrupt."""
     if not _REGISTRY:
         _register_builtin_nodes()
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {directory}")
+    if step is not None:
+        return _load_step(directory, step)
+    newest = latest_step(directory)
+    if newest is None:
+        raise FileNotFoundError(f"no checkpoint under {directory}")
+    candidates = sorted(set(_scan_steps(directory)) | {newest}, reverse=True)
+    legacy_exc: Optional[LegacyCheckpointError] = None
+    last_exc: Optional[Exception] = None
+    for s in candidates:
+        try:
+            return _load_step(directory, s)
+        except LegacyCheckpointError as exc:
+            legacy_exc = exc
+        except (ValueError, OSError, KeyError, EOFError, zipfile.BadZipFile) as exc:
+            last_exc = exc
+            logger.warning(
+                "skipping unreadable checkpoint step %d under %s: %s",
+                s, directory, exc,
+            )
+            try:
+                from photon_tpu.obs import registry
+
+                registry().counter("checkpoint_corrupt_skipped_total").inc()
+            except Exception:
+                pass
+    if legacy_exc is not None:
+        raise legacy_exc
+    assert last_exc is not None
+    raise last_exc
+
+
+def _load_step(directory: str, step: int) -> Tuple[Any, int]:
     # allow_pickle stays False (numpy default): object arrays are rejected.
     path = os.path.join(directory, f"step_{step}.npz")
     try:

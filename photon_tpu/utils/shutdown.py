@@ -1,0 +1,75 @@
+"""Cooperative SIGTERM/SIGINT handling for the training drivers.
+
+One signal requests a graceful stop: work loops poll
+:func:`shutdown_requested` at safe boundaries (a coordinate-descent pass
+boundary, a λ-sweep step), persist a final checkpoint, and raise
+:class:`GracefulShutdown`; the driver catches it, finalizes the run report,
+and exits ``128 + signum`` — the conventional killed-by-signal code, so
+orchestrators classify the exit correctly. A SECOND signal keeps its default
+(fatal) behavior: the handler restores the previous handlers on first
+receipt, so an operator can always escalate past a stuck step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import signal as _signal
+import threading
+from typing import Optional
+
+
+class GracefulShutdown(Exception):
+    """A SIGTERM/SIGINT was received and the cooperative shutdown point was
+    reached: the work loop stopped at a safe boundary (checkpoint written).
+    Drivers catch this, finalize telemetry, and exit 128+signum."""
+
+    def __init__(self, signum: int):
+        super().__init__(f"terminated by signal {signum}")
+        self.signum = signum
+
+
+_TERM_STATE = {"signum": None}
+
+
+def shutdown_requested() -> Optional[int]:
+    """Signum of a received SIGTERM/SIGINT inside :func:`handle_termination`,
+    else None."""
+    return _TERM_STATE["signum"]
+
+
+@contextlib.contextmanager
+def handle_termination():
+    """Convert the FIRST SIGTERM/SIGINT into a cooperative shutdown request
+    (see :func:`shutdown_requested`); previous handlers are restored
+    immediately, so a second signal is fatal. No-op off the main thread
+    (signal handlers are main-thread-only in CPython)."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    _TERM_STATE["signum"] = None
+    prev = {}
+
+    def _restore():
+        for sig, h in prev.items():
+            try:
+                _signal.signal(sig, h)
+            except (ValueError, OSError):
+                pass
+
+    def _on_signal(signum, frame):
+        _TERM_STATE["signum"] = signum
+        logging.getLogger("photon_tpu").warning(
+            "received signal %d: finishing the current step, writing a "
+            "final checkpoint, then exiting (send again to kill now)",
+            signum,
+        )
+        _restore()  # second signal falls through to the default handler
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        prev[sig] = _signal.signal(sig, _on_signal)
+    try:
+        yield
+    finally:
+        _restore()
+        _TERM_STATE["signum"] = None
