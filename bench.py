@@ -1295,6 +1295,406 @@ def run_fault_soak(n_requests: int = 3000, d: int = 32, E: int = 512):
     }
 
 
+def run_serve_soak(
+    duration_s: float = 20.0,
+    workers: int = 2,
+    d: int = 16,
+    E: int = 1500,
+    p99_bar_ms: float = 800.0,
+    abuser_qps: float = 20.0,
+):
+    """Sustained-load soak of the MULTI-PROCESS serving front end — the
+    ROADMAP's remaining serving success metric (sustained throughput with a
+    p99 bar, not just fault survival).
+
+    Drives a real ``game_serving --workers N`` subprocess (forked HTTP
+    workers + one device-owning scorer) with mixed hot/cold-entity traffic
+    from several tenants while a publisher thread writes new model
+    generations (``save_game_model`` + fsync'd LATEST pointer) that the
+    ``--reload-poll-interval`` watcher hot-swaps — the full train→serve
+    loop under churn. The last ~40% of the run adds an abusive tenant
+    flooding far past its token-bucket quota.
+
+    Acceptance (ISSUE 7): zero caller-visible errors (only 200/429 leave
+    the server); every well-behaved tenant's p99 stays under the bar EVEN
+    during the abuse phase while the abuser sheds 429s; ≥2 model
+    generations actually swap in; 0 retraces after warm-up; and a probe set
+    scored over HTTP is bit-identical to an in-process engine loaded from
+    the same model dir (the batch-scoring path). SIGTERM must drain and
+    exit 0.
+    """
+    import http.client
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.io.model_io import publish_latest_pointer, save_game_model
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(41)
+    root = tempfile.mkdtemp(prefix="photon-soak-")
+    imap = IndexMap.build([f"f{j:04d}" for j in range(d)])
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"u{e}")
+    imap.save(os.path.join(root, "index-map-s.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    w_fix = rng.normal(size=d).astype(np.float32)
+
+    def publish(gen: str, scale: float) -> str:
+        model = GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(np.asarray(w_fix * scale)),
+                    TaskType.LOGISTIC_REGRESSION,
+                ),
+                "s",
+            ),
+            "per_user": RandomEffectModel(
+                (rng.normal(size=(E, d)) / 4).astype(np.float32),
+                "userId", "s", TaskType.LOGISTIC_REGRESSION,
+            ),
+        })
+        gen_dir = os.path.join(root, gen)
+        # threshold 0: keep every nonzero coefficient so the round trip is
+        # exact and HTTP-vs-local parity below can demand bitwise equality.
+        save_game_model(
+            model, gen_dir, {"s": imap}, {"userId": eidx},
+            sparsity_threshold=0.0,
+        )
+        publish_latest_pointer(root, gen)
+        return gen_dir
+
+    publish("gen-000", 1.0)
+    _progress(f"serve soak: starting game_serving --workers {workers}")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_tpu.cli.game_serving",
+         "--model-input-dir", root, "--port", "0",
+         "--workers", str(workers),
+         "--max-batch-size", "32", "--max-delay-ms", "2",
+         "--queue-cap", "2048", "--deadline-ms", "10000",
+         "--reload-poll-interval", "0.25",
+         "--tenant-qps", f"abuser={abuser_qps:g}",
+         "--tenant-burst", f"abuser={abuser_qps:g}",
+         "--telemetry-out", os.path.join(root, "serve-run.jsonl"),
+         "--telemetry-flush-interval", "2.0",
+         "--telemetry-max-mb", "4"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    banner = {}
+
+    def _read_banner():
+        banner["line"] = proc.stdout.readline()
+
+    rt = threading.Thread(target=_read_banner, daemon=True)
+    rt.start()
+    rt.join(timeout=300.0)
+    if not banner.get("line"):
+        proc.kill()
+        raise RuntimeError("game_serving did not come up within 300s")
+    up = json.loads(banner["line"])
+    port = up["port"]
+
+    class Client:
+        """One persistent HTTP connection; reconnects once per request
+        (workers close idle keep-alives after their handler timeout)."""
+
+        def __init__(self, tenant=None, priority=None):
+            self.headers = {}
+            if tenant:
+                self.headers["X-Tenant"] = tenant
+            if priority:
+                self.headers["X-Priority"] = priority
+            self.conn = None
+
+        def _connect(self):
+            self.conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=60
+            )
+
+        def post(self, path, body: bytes):
+            for attempt in (0, 1):
+                try:
+                    if self.conn is None:
+                        self._connect()
+                    self.conn.request(
+                        "POST", path, body=body,
+                        headers={**self.headers,
+                                 "Content-Type": "application/json"},
+                    )
+                    resp = self.conn.getresponse()
+                    return resp.status, resp.read()
+                except (http.client.HTTPException, OSError):
+                    try:
+                        self.conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self.conn = None
+                    if attempt:
+                        raise
+            raise AssertionError("unreachable")
+
+        def get(self, path):
+            for attempt in (0, 1):
+                try:
+                    if self.conn is None:
+                        self._connect()
+                    self.conn.request("GET", path, headers=self.headers)
+                    resp = self.conn.getresponse()
+                    return resp.status, resp.read()
+                except (http.client.HTTPException, OSError):
+                    try:
+                        self.conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self.conn = None
+                    if attempt:
+                        raise
+
+    def req_body(i: int) -> bytes:
+        x = rng_local[i % len(rng_local)]
+        # 80% hot head (first 64 entities), 20% cold tail.
+        e = int(x[0] * 64) if x[1] < 0.8 else 64 + int(x[0] * (E - 64))
+        return json.dumps({
+            "features": {"s": X[i % len(X)].tolist()},
+            "entityIds": {"userId": f"u{e}"},
+        }).encode()
+
+    n_pool = 512
+    X = rng.normal(size=(n_pool, d)).astype(np.float32)
+    rng_local = rng.random(size=(4096, 2))
+
+    t_start = time.perf_counter()
+    abuse_at = t_start + duration_s * 0.6
+    t_end = t_start + duration_s
+    lock = threading.Lock()
+    # tenant -> list of (t_rel, latency_ms) for 200s; status counters.
+    lat: dict = {}
+    status_counts: dict = {}
+    errors = []
+
+    def record(tenant, status, t0, t1, body=b""):
+        with lock:
+            status_counts.setdefault(tenant, {}).setdefault(status, 0)
+            status_counts[tenant][status] += 1
+            if status == 200:
+                lat.setdefault(tenant, []).append(
+                    (t0 - t_start, (t1 - t0) * 1e3)
+                )
+            elif status not in (200, 429):
+                errors.append((tenant, status, body[:200]))
+
+    def interactive_loop(tenant, seed):
+        c = Client(tenant=tenant)
+        i = seed
+        while time.perf_counter() < t_end:
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                status, body = c.post("/v1/score", req_body(i))
+            except Exception as exc:  # noqa: BLE001 — counts as caller error
+                record(tenant, -1, t0, time.perf_counter(), repr(exc).encode())
+                continue
+            record(tenant, status, t0, time.perf_counter(), body)
+
+    def bulk_loop():
+        c = Client(tenant="bulk", priority="batch")
+        i = 9000
+        while time.perf_counter() < t_end:
+            i += 16
+            lines = b"".join(req_body(i + k) + b"\n" for k in range(16))
+            t0 = time.perf_counter()
+            try:
+                status, body = c.post("/v1/score-batch", lines)
+            except Exception as exc:  # noqa: BLE001
+                record("bulk", -1, t0, time.perf_counter(), repr(exc).encode())
+                continue
+            t1 = time.perf_counter()
+            if status != 200:
+                record("bulk", status, t0, t1, body)
+                continue
+            # Per-line outcomes: scores count as oks, 429s as sheds,
+            # anything else (e.g. per-line 400) is a caller error.
+            for ln in body.splitlines():
+                o = json.loads(ln)
+                if "score" in o:
+                    record("bulk", 200, t0, t1)
+                else:
+                    record("bulk", o.get("code", -1), t0, t1, ln)
+
+    def abuser_loop(seed):
+        c = Client(tenant="abuser")
+        i = seed
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                return
+            if now < abuse_at:
+                time.sleep(0.05)
+                continue
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                status, body = c.post("/v1/score", req_body(i))
+            except Exception as exc:  # noqa: BLE001
+                record("abuser", -1, t0, time.perf_counter(),
+                       repr(exc).encode())
+                continue
+            record("abuser", status, t0, time.perf_counter(), body)
+
+    reloads_published = [0]
+
+    def publisher_loop():
+        while time.perf_counter() < t_end - 1.0:
+            time.sleep(2.0)
+            reloads_published[0] += 1
+            publish(f"gen-{reloads_published[0]:03d}",
+                    1.0 + 0.01 * reloads_published[0])
+
+    tenants = ["web", "mobile", "partner"]
+    threads = [
+        threading.Thread(target=interactive_loop, args=(t, 1000 * k))
+        for k, t in enumerate(tenants)
+    ]
+    threads.append(threading.Thread(target=bulk_loop))
+    threads.extend(
+        threading.Thread(target=abuser_loop, args=(7000 + 100 * k,))
+        for k in range(4)
+    )
+    threads.append(threading.Thread(target=publisher_loop))
+    _progress(f"serve soak: {duration_s:.0f}s mixed load, abuse at 60%")
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+
+    # --- final generation swap + parity probe -----------------------------
+    final_gen = f"gen-{reloads_published[0] + 1:03d}-final"
+    final_dir = publish(final_gen, 2.0)
+    probe = Client(tenant="probe")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        _, hb = probe.get("/healthz")
+        health = json.loads(hb)
+        if health["model_version"].endswith(final_gen):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(
+            f"final generation never swapped in: {health['model_version']}"
+        )
+
+    _progress("serve soak: HTTP-vs-batch parity probe")
+    probe_n = 48
+    http_scores = np.zeros(probe_n, np.float32)
+    for i in range(probe_n):
+        status, body = probe.post("/v1/score", req_body(i))
+        assert status == 200, (status, body)
+        http_scores[i] = np.float32(json.loads(body)["score"])
+    from photon_tpu.serve import ServeConfig as _SC
+    from photon_tpu.serve.engine import load_engine as _load_engine
+
+    ref = _load_engine(final_dir, artifacts_dir=root,
+                       config=_SC(max_batch_size=32))
+    ref_scores = np.asarray(
+        [ref.submit(_soak_ref_request(req_body(i))).result(timeout=120)
+         for i in range(probe_n)], np.float32,
+    )
+    ref.close()
+    exact = int(np.sum(http_scores == ref_scores))
+
+    _, hb = probe.get("/healthz")
+    health = json.loads(hb)
+
+    # --- graceful shutdown -------------------------------------------------
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=90)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise AssertionError("SIGTERM drain did not finish within 90s")
+
+    def p99(tenant, after=None):
+        pts = [ms for (ts, ms) in lat.get(tenant, [])
+               if after is None or ts >= after]
+        if not pts:
+            return None
+        return float(np.percentile(np.asarray(pts), 99))
+
+    abuse_rel = duration_s * 0.6
+    ok_total = sum(len(v) for v in lat.values())
+    per_tenant = {}
+    for t in tenants + ["bulk", "abuser"]:
+        per_tenant[t] = {
+            "ok": len(lat.get(t, [])),
+            "shed_429": status_counts.get(t, {}).get(429, 0),
+            "p99_ms": None if p99(t) is None else round(p99(t), 1),
+            "p99_abuse_phase_ms": (
+                None if p99(t, abuse_rel) is None
+                else round(p99(t, abuse_rel), 1)
+            ),
+        }
+    abuser_shed = per_tenant["abuser"]["shed_429"]
+    tenant_stats = health.get("tenants", {})
+
+    assert not errors, f"caller-visible errors during soak: {errors[:5]}"
+    assert exact == probe_n, (
+        f"HTTP-vs-batch parity: only {exact}/{probe_n} bit-identical"
+    )
+    assert health["retraces_since_warmup"] == 0, health
+    assert reloads_published[0] >= 2 and health["model_version"].endswith(
+        final_gen
+    ), (reloads_published[0], health["model_version"])
+    assert abuser_shed > 0, (
+        f"abuser never shed despite {abuser_qps:g} qps quota: {per_tenant}"
+    )
+    assert tenant_stats.get("abuser", {}).get("shed", 0) > 0, tenant_stats
+    for t in tenants:
+        bar = per_tenant[t]["p99_abuse_phase_ms"]
+        assert bar is not None and bar <= p99_bar_ms, (
+            f"tenant {t} p99 {bar}ms over the {p99_bar_ms:g}ms bar during "
+            f"the abuse phase: {per_tenant}"
+        )
+    assert rc == 0, f"SIGTERM drain exited {rc}, want 0"
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "metric": "serve_soak",
+        "unit": "ok_requests",
+        "value": ok_total,
+        "wall_s": round(wall, 2),
+        "sustained_rps": round(ok_total / wall, 1),
+        "workers": workers,
+        "p99_bar_ms": p99_bar_ms,
+        "tenants": per_tenant,
+        "caller_errors": len(errors),
+        "bit_exact_probe": f"{exact}/{probe_n}",
+        "retraces_after_warmup": health["retraces_since_warmup"],
+        "model_generations_published": reloads_published[0] + 2,
+        "final_model_version": health["model_version"],
+        "scorer_tenants": tenant_stats,
+        "graceful_exit_code": rc,
+    }
+
+
+def _soak_ref_request(body: bytes):
+    from photon_tpu.serve.frontend import request_from_json
+
+    return request_from_json(json.loads(body))
+
+
 def measure_cpu_baseline():
     """Same workload on CPU: scipy L-BFGS-B fixed effect + per-entity scipy
     solves, with identical data-pass accounting."""
@@ -1637,6 +2037,26 @@ def main():
         # Serving soak under injected store faults + reload churn: zero
         # caller-visible crashes, breaker trips + recovers; CPU-measurable.
         print(json.dumps(run_fault_soak()))
+        return
+    if "--serve-soak" in sys.argv:
+        # Multi-process front end under sustained mixed-tenant load with
+        # reload churn + an abusive-tenant phase: p99 bar, per-tenant
+        # fairness, bit parity vs the batch path; CPU-measurable.
+        def _soak_opt(flag, default, cast):
+            if flag in sys.argv:
+                try:
+                    return cast(sys.argv[sys.argv.index(flag) + 1])
+                except (IndexError, ValueError):
+                    print(f"usage: bench.py --serve-soak [{flag} <value>]",
+                          file=sys.stderr)
+                    sys.exit(2)
+            return default
+
+        print(json.dumps(run_serve_soak(
+            duration_s=_soak_opt("--soak-duration", 20.0, float),
+            workers=_soak_opt("--soak-workers", 2, int),
+            p99_bar_ms=_soak_opt("--soak-p99-ms", 800.0, float),
+        )))
         return
     if "--rmatvec-cpu-ab" in sys.argv:
         # Four sparse-rmatvec lowerings head-to-head at CPU-mesh scale

@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|serve|faults|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|serve|faults|soak|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -399,6 +399,19 @@ EOF
     rm -rf "$tmp"
 }
 
+run_soak() {
+    # Multi-process serving smoke: forked HTTP workers + scorer process
+    # under mixed-tenant load with LATEST-pointer reload churn and an
+    # abusive tenant. run_serve_soak asserts the PR-7 acceptance bar
+    # itself: zero caller-visible errors, per-tenant fairness under abuse
+    # (abuser sheds 429s, others hold p99), HTTP-vs-batch bit parity,
+    # zero retraces after warm-up, and a clean SIGTERM drain (exit 0).
+    echo "== soak: multi-process serve under quota + reload churn =="
+    JAX_PLATFORMS=cpu python bench.py --serve-soak \
+        --soak-duration 8 --soak-workers 2
+    echo "   serve-soak smoke OK"
+}
+
 run_install() {
     echo "== packaging: editable install + console entry points =="
     tmp="$(mktemp -d)"
@@ -429,8 +442,9 @@ case "$stage" in
     active-set) run_active_set ;;
     serve) run_serve ;;
     faults) run_faults ;;
+    soak) run_soak ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_serve; run_faults; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_serve; run_faults; run_soak; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

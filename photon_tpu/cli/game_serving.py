@@ -1,27 +1,43 @@
-"""GAME online-serving driver: stdlib HTTP/JSONL front end over the
-in-process ServingEngine.
+"""GAME online-serving driver: HTTP/JSONL front end over the ServingEngine.
 
 TPU-new driver (no reference counterpart — photon-client ends at batch
-scoring): stands up serve/engine.py behind a threaded stdlib HTTP server.
-One OS thread per connection feeds the shared micro-batcher, which is
-exactly the concurrency shape the batcher was built for: many producer
-threads, one flusher, one jitted scorer.
+scoring). Two deployment shapes share ONE endpoint implementation
+(serve/frontend.py):
+
+- ``--workers 0`` (default): the original in-process shape — a threaded
+  stdlib HTTP server feeding the engine directly. Right for tests, smoke
+  stages, and single-tenant batch backfill.
+- ``--workers N``: the traffic shape — N forked HTTP worker processes
+  accept/parse on a shared listening socket and relay over a Unix-domain
+  socket to THIS process, which owns the device and runs the same
+  admission → MicroBatcher → ServingEngine path. Request parsing no longer
+  shares a GIL with the scorer; bit-parity and the zero-retrace contract
+  are unchanged because the scoring path is byte-for-byte the same.
 
 Endpoints (JSON unless noted):
 
 - ``POST /v1/score`` — one request: ``{"features": {shard: [f0..fd] |
   {key: value}}, "entityIds": {reType: id}, "offset": 0.0}`` →
-  ``{"score": s, "modelVersion": v}``. 429 on shed, 504 on deadline.
+  ``{"score": s, "modelVersion": v}``. 429 on shed (quota or
+  backpressure — ``kind`` in the body tells which), 504 on deadline.
 - ``POST /v1/score-batch`` — JSONL body, one request per line → JSONL
-  response, one ``{"score": s}`` (or ``{"error": ...}``) per line, order
-  preserved.
+  response, one ``{"score": s}`` (or per-line ``{"error", "code",
+  "kind"}``) per line, order preserved. A malformed line is a per-line
+  400; it never masquerades as a 429 shed.
 - ``POST /v1/reload`` — ``{"modelDir": path}``: zero-downtime swap; old
   model serves until the new one is warmed.
 - ``GET /healthz`` — engine stats (queue depth, store residency, trace
-  counts, model version).
+  counts, model version, per-tenant admission state).
 
-Shutdown (SIGTERM/SIGINT) drains the queue and, with ``--telemetry-out``,
-writes the unified run report.
+Multi-tenant admission: ``X-Tenant`` / ``X-Priority`` headers (or
+``tenant``/``priority`` request fields) route each request through
+token-bucket QPS quotas (``--tenant-qps a=50,b=500``) and priority classes
+(interactive vs batch) — see serve/admission.py.
+
+Shutdown (SIGTERM/SIGINT) drains workers first, then the queue, and with
+``--telemetry-out`` writes the unified run report (size-capped via
+``--telemetry-max-mb``, flushed periodically under
+``--telemetry-flush-interval`` so soaks are observable live).
 """
 
 from __future__ import annotations
@@ -32,13 +48,33 @@ import logging
 import os
 import signal
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 
 from photon_tpu.cli.common import setup_logging
+from photon_tpu.serve.admission import AdmissionConfig, parse_tenant_rates
 from photon_tpu.serve.batcher import BackpressureError, DeadlineExceededError
 from photon_tpu.serve.engine import ServeConfig, ScoreRequest, load_engine
+from photon_tpu.serve.frontend import (
+    LocalBackend,
+    ServingFrontend,
+    make_http_handler,
+    request_from_json,
+)
+
+__all__ = [
+    "BackpressureError",
+    "DeadlineExceededError",
+    "ScoreRequest",
+    "build_parser",
+    "main",
+    "make_handler",
+    "resolve_model_dir",
+    "run",
+]
 
 logger = logging.getLogger(__name__)
+
+_request_from_json = request_from_json  # back-compat alias
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -50,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8712,
                    help="0 picks an ephemeral port (printed on startup)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="HTTP worker processes. 0 = in-process threaded "
+                        "server (tests/smoke). N>0 forks N parse/accept "
+                        "workers sharing one listen socket, relaying over a "
+                        "Unix socket to this device-owning scorer process")
     p.add_argument("--max-batch-size", type=int, default=64,
                    help="micro-batch row cap; rounded UP onto the bucket_dim "
                         "shape grid so warm-up covers every dispatch shape")
@@ -65,8 +106,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="default per-request deadline (queue wait + scoring); "
                         "expired requests fail 504 without scorer time")
+    p.add_argument("--tenant-default-qps", type=float, default=None,
+                   help="token-bucket QPS quota for tenants not named in "
+                        "--tenant-qps (unset = unknown tenants are "
+                        "quota-exempt)")
+    p.add_argument("--tenant-default-burst", type=float, default=None,
+                   help="bucket burst capacity for the default quota")
+    p.add_argument("--tenant-qps", default=None,
+                   help="per-tenant QPS quotas, e.g. 'abuser=50,partner=500'")
+    p.add_argument("--tenant-burst", default=None,
+                   help="per-tenant burst capacities, same syntax")
+    p.add_argument("--batch-queue-fraction", type=float, default=0.5,
+                   help="batch-priority requests are admitted only while "
+                        "queue depth is below this fraction of --queue-cap "
+                        "(the rest is reserved for interactive traffic)")
     p.add_argument("--telemetry-out", default=None,
                    help="write the unified run report JSONL here on shutdown")
+    p.add_argument("--telemetry-flush-interval", type=float, default=0.0,
+                   help="seconds between live run-report rewrites during "
+                        "serving (0 = only at shutdown)")
+    p.add_argument("--telemetry-max-mb", type=float, default=64.0,
+                   help="byte budget for the run report: the previous file "
+                        "rotates to <path>.1 and span records drop "
+                        "oldest-first to fit (0 = unbounded)")
     p.add_argument("--reload-poll-interval", type=float, default=0.0,
                    help="seconds between checks of the model dir for a new "
                         "generation (a LATEST pointer file naming a subdir, "
@@ -135,139 +197,145 @@ def _reload_watcher(engine, model_dir: str, interval: float,
         current = fp
 
 
-def _request_from_json(obj: dict) -> ScoreRequest:
-    if not isinstance(obj, dict) or "features" not in obj:
-        raise ValueError("request must be a JSON object with 'features'")
-    return ScoreRequest(
-        features=dict(obj["features"]),
-        entity_ids=dict(obj.get("entityIds", {})),
-        offset=float(obj.get("offset", 0.0)),
-        uid=obj.get("uid"),
+def make_handler(engine, artifacts_dir=None):
+    """Back-compat factory: the in-process HTTP handler over ``engine``."""
+    return make_http_handler(LocalBackend(engine))
+
+
+def _admission_config(args) -> AdmissionConfig:
+    return AdmissionConfig(
+        default_qps=args.tenant_default_qps,
+        default_burst=args.tenant_default_burst,
+        tenant_qps=parse_tenant_rates(args.tenant_qps),
+        tenant_burst=parse_tenant_rates(args.tenant_burst),
+        batch_queue_fraction=args.batch_queue_fraction,
     )
 
 
-def make_handler(engine, artifacts_dir):
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-
-        def log_message(self, fmt, *args):  # route through logging, not stderr
-            logger.debug("http: " + fmt, *args)
-
-        def _reply(self, code: int, payload: bytes, ctype="application/json"):
-            self.send_response(code)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-
-        def _reply_json(self, code: int, obj) -> None:
-            self._reply(code, (json.dumps(obj) + "\n").encode())
-
-        def _body(self) -> bytes:
-            length = int(self.headers.get("Content-Length", 0))
-            return self.rfile.read(length)
-
-        def do_GET(self):
-            if self.path == "/healthz":
-                self._reply_json(200, engine.stats())
-            else:
-                self._reply_json(404, {"error": f"no route {self.path}"})
-
-        def do_POST(self):
-            try:
-                if self.path == "/v1/score":
-                    self._score_one()
-                elif self.path == "/v1/score-batch":
-                    self._score_jsonl()
-                elif self.path == "/v1/reload":
-                    self._reload()
-                else:
-                    self._reply_json(404, {"error": f"no route {self.path}"})
-            except BackpressureError as exc:
-                self._reply_json(429, {"error": str(exc)})
-            except DeadlineExceededError as exc:
-                self._reply_json(504, {"error": str(exc)})
-            except (ValueError, KeyError, json.JSONDecodeError) as exc:
-                self._reply_json(400, {"error": str(exc)})
-            except Exception as exc:  # noqa: BLE001 — 500, keep serving
-                logger.exception("request failed")
-                self._reply_json(500, {"error": str(exc)})
-
-        def _score_one(self):
-            req = _request_from_json(json.loads(self._body()))
-            score = engine.submit(req).result()
-            self._reply_json(
-                200, {"score": score, "modelVersion": engine.model_version}
-            )
-
-        def _score_jsonl(self):
-            # Submit every line first (they co-batch), then collect in
-            # order — a serial submit/await loop would defeat micro-batching.
-            futures = []
-            for line in self._body().splitlines():
-                if not line.strip():
-                    continue
-                try:
-                    futures.append(
-                        engine.submit(_request_from_json(json.loads(line)))
-                    )
-                except (BackpressureError, ValueError,
-                        json.JSONDecodeError) as exc:
-                    futures.append(exc)
-            out = []
-            for f in futures:
-                if isinstance(f, Exception):
-                    out.append({"error": str(f)})
-                else:
-                    try:
-                        out.append({"score": f.result()})
-                    except Exception as exc:  # noqa: BLE001 — per-line error
-                        out.append({"error": str(exc)})
-            payload = "".join(json.dumps(o) + "\n" for o in out).encode()
-            self._reply(200, payload, ctype="application/jsonl")
-
-        def _reload(self):
-            from photon_tpu.io.model_io import load_game_model
-
-            body = json.loads(self._body()) if self.headers.get(
-                "Content-Length"
-            ) else {}
-            model_dir = body.get("modelDir")
-            if not model_dir:
-                raise ValueError("reload needs {'modelDir': path}")
-            # Index maps / entity indexes are generation-stable artifacts
-            # (the training pipeline reuses them across model refreshes);
-            # only the coefficient tables swap.
-            model = load_game_model(
-                model_dir, engine._index_maps, engine._entity_indexes,
-                to_device=False,
-            )
-            info = engine.reload(model, body.get("modelVersion") or model_dir)
-            self._reply_json(200, info)
-
-    return Handler
-
-
-def run(args):
-    setup_logging(args.verbose)
-    from photon_tpu.obs import begin_run, finalize_run_report
-
-    begin_run()
-    config = ServeConfig(
+def _serve_config(args) -> ServeConfig:
+    return ServeConfig(
         max_batch_size=args.max_batch_size,
         max_delay_ms=args.max_delay_ms,
         queue_cap=args.queue_cap,
         hot_bytes=int(args.hot_bytes_mb * (1 << 20)),
         default_deadline_ms=args.deadline_ms,
+        admission=_admission_config(args),
     )
-    logger.info("loading + warming model from %s", args.model_input_dir)
-    engine = load_engine(
-        args.model_input_dir,
-        artifacts_dir=args.model_artifacts_dir,
-        config=config,
-    )
+
+
+def _telemetry_max_bytes(args):
+    mb = float(args.telemetry_max_mb or 0.0)
+    return int(mb * (1 << 20)) if mb > 0 else None
+
+
+def _start_background(args, engine, stop: threading.Event) -> None:
+    """Reload watcher + periodic telemetry flusher, both deployment shapes."""
+    if args.reload_poll_interval and args.reload_poll_interval > 0:
+        threading.Thread(
+            target=_reload_watcher,
+            args=(engine, args.model_input_dir, args.reload_poll_interval,
+                  stop),
+            name="model-reload-watcher",
+            daemon=True,
+        ).start()
+    if args.telemetry_out and args.telemetry_flush_interval > 0:
+        from photon_tpu.obs.report import collect_run_records, write_run_report
+
+        max_bytes = _telemetry_max_bytes(args)
+
+        def _flush_loop():
+            while not stop.wait(args.telemetry_flush_interval):
+                try:
+                    write_run_report(
+                        args.telemetry_out,
+                        collect_run_records("game_serving"),
+                        max_bytes=max_bytes,
+                    )
+                except Exception:  # noqa: BLE001 — telemetry never kills serving
+                    logger.exception("periodic telemetry flush failed")
+
+        threading.Thread(
+            target=_flush_loop, name="telemetry-flush", daemon=True
+        ).start()
+
+
+def _load_engine(args, config: ServeConfig):
+    model_dir = resolve_model_dir(args.model_input_dir)
+    logger.info("loading + warming model from %s", model_dir)
+    artifacts = args.model_artifacts_dir
+    if artifacts is None and model_dir != args.model_input_dir:
+        # LATEST resolved to a generation subdir; the artifacts live
+        # beside the generations, in the publication root.
+        artifacts = args.model_input_dir
+    return load_engine(model_dir, artifacts_dir=artifacts, config=config)
+
+
+def _startup_banner(engine, host, port, workers: int) -> None:
+    print(json.dumps({
+        "serving": True,
+        "host": host,
+        "port": port,
+        "workers": workers,
+        "maxBatchSize": engine.max_batch,
+        "modelVersion": engine.model_version,
+    }), flush=True)
+
+
+def _run_multiprocess(args):
+    """The traffic shape: fork N workers FIRST (single-threaded, jax not
+    yet initialized — fork safety), then build the engine and serve the
+    scorer IPC socket from this process."""
+    from photon_tpu.obs import begin_run, finalize_run_report
+
+    frontend = ServingFrontend(args.host, args.port, args.workers)
+    frontend.fork_workers()  # before any jax init, see ServingFrontend
+    stop = threading.Event()
+
+    def _shutdown(signum, frame):
+        stop.set()
+
+    # Handlers go in BEFORE the (slow) engine warm-up: a SIGTERM during
+    # warm-up must still reach frontend.shutdown(), or the forked workers
+    # would outlive the parent as orphans.
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    begin_run()
+    try:
+        engine = _load_engine(args, _serve_config(args))
+    except BaseException:
+        frontend.shutdown()
+        raise
+    frontend.start_scorer(engine)
+    _start_background(args, engine, stop)
+    _startup_banner(engine, frontend.host, frontend.port, args.workers)
+    try:
+        while not stop.wait(0.5):
+            frontend.poll_workers()
+            if frontend.live_workers() == 0:
+                logger.error("all serve workers exited; shutting down")
+                break
+    finally:
+        stop.set()
+        frontend.shutdown()  # workers drain first: no new admissions
+        engine.close(drain=True)  # then score out what's queued
+        finalize_run_report(
+            "game_serving", path=args.telemetry_out,
+            max_bytes=_telemetry_max_bytes(args),
+        )
+        print(json.dumps({
+            "serving": False,
+            "stats": engine.stats(),
+            "workerExits": {str(k): v for k, v in frontend.worker_exits.items()},
+        }))
+
+
+def _run_inprocess(args):
+    from photon_tpu.obs import begin_run, finalize_run_report
+
+    begin_run()
+    engine = _load_engine(args, _serve_config(args))
     server = ThreadingHTTPServer(
-        (args.host, args.port), make_handler(engine, args.model_artifacts_dir)
+        (args.host, args.port), make_handler(engine)
     )
     server.daemon_threads = True
     stop = threading.Event()
@@ -278,27 +346,29 @@ def run(args):
 
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
-    if args.reload_poll_interval and args.reload_poll_interval > 0:
-        threading.Thread(
-            target=_reload_watcher,
-            args=(engine, args.model_input_dir, args.reload_poll_interval, stop),
-            name="model-reload-watcher",
-            daemon=True,
-        ).start()
-    print(json.dumps({
-        "serving": True,
-        "host": server.server_address[0],
-        "port": server.server_address[1],
-        "maxBatchSize": engine.max_batch,
-        "modelVersion": engine.model_version,
-    }), flush=True)
+    _start_background(args, engine, stop)
+    _startup_banner(
+        engine, server.server_address[0], server.server_address[1], 0
+    )
     try:
         server.serve_forever(poll_interval=0.2)
     finally:
+        stop.set()
         engine.close(drain=True)
         server.server_close()
-        finalize_run_report("game_serving", path=args.telemetry_out)
+        finalize_run_report(
+            "game_serving", path=args.telemetry_out,
+            max_bytes=_telemetry_max_bytes(args),
+        )
         print(json.dumps({"serving": False, "stats": engine.stats()}))
+
+
+def run(args):
+    setup_logging(args.verbose)
+    if args.workers and args.workers > 0:
+        _run_multiprocess(args)
+    else:
+        _run_inprocess(args)
 
 
 def main(argv=None):

@@ -40,7 +40,11 @@ from photon_tpu.estimators.game_estimator import GameEstimator
 from photon_tpu.evaluation.metrics_map import sanitize_for_json
 from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
 from photon_tpu.io.data_reader import read_merged
-from photon_tpu.io.model_io import load_game_model, save_game_model
+from photon_tpu.io.model_io import (
+    load_game_model,
+    publish_latest_pointer,
+    save_game_model,
+)
 from photon_tpu.types import NormalizationType
 from photon_tpu.utils.timed import Timed
 
@@ -474,6 +478,10 @@ def run(args) -> Dict:
             imap.save(os.path.join(args.output_dir, f"index-map-{shard}.json"))
         for re_type, eidx in entity_indexes.items():
             eidx.save(os.path.join(args.output_dir, f"entity-index-{re_type}.json"))
+        # Artifacts are on disk; NOW flip the fsync'd LATEST pointer so a
+        # polling game_serving (--reload-poll-interval) hot-swaps a fully
+        # written generation, never a partial one.
+        publish_latest_pointer(args.output_dir, "best")
     summary["best"] = {"config": best.config.describe(), "metrics": best.metrics}
     with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
         # Non-finite metrics (e.g. AIC at the n−k−1=0 pole) become null:

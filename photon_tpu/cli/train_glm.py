@@ -33,7 +33,7 @@ from photon_tpu.evaluation.metrics_map import (
 )
 from photon_tpu.io.data_reader import FeatureShardConfig, read_merged
 from photon_tpu.io.libsvm import read_libsvm
-from photon_tpu.io.model_io import save_game_model
+from photon_tpu.io.model_io import publish_latest_pointer, save_game_model
 from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_SCHEMA
 from photon_tpu.io.avro import write_avro_records
 from photon_tpu.models.coefficients import Coefficients
@@ -602,6 +602,9 @@ def run(args) -> Dict:
         }
     )
     save_game_model(game, os.path.join(args.output_dir, "best"), {"features": imap})
+    # fsync'd LATEST pointer: game_serving --reload-poll-interval follows
+    # it, so a retrain hot-swaps into a live server with zero downtime.
+    publish_latest_pointer(args.output_dir, "best")
     summary = {
         "best_lambda": best["lambda"],
         "models": [

@@ -276,6 +276,37 @@ def save_game_model(
         json.dump(meta, f, indent=2)
 
 
+def publish_latest_pointer(publish_root: str, generation: str) -> str:
+    """Atomically publish ``generation`` (a subdirectory of
+    ``publish_root``, or an absolute path) as the CURRENT model: write a
+    fsync'd ``LATEST`` pointer file via tmp+rename, same torn-write
+    discipline as checkpoint publication (utils/checkpoint.py).
+
+    This is the training half of the train→serve loop:
+    ``game_serving --reload-poll-interval`` follows the pointer
+    (``resolve_model_dir``) and hot-swaps each new generation with zero
+    downtime. A crash mid-publish leaves either the old pointer or the new
+    one — never a torn file — and the pointed-to directory is always fully
+    written (callers publish AFTER ``save_game_model`` returns)."""
+    os.makedirs(publish_root, exist_ok=True)
+    path = os.path.join(publish_root, "LATEST")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(generation.strip() + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:  # best-effort directory fsync: make the rename itself durable
+        dfd = os.open(publish_root, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    return path
+
+
 def _scan_model_dir(model_dir: str, meta: dict) -> Dict[str, dict]:
     """Reconstruct per-coordinate info by scanning a reference-written model
     directory (the reference stores NO coordinate table in its metadata —

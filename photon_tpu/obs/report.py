@@ -23,6 +23,7 @@ strict JSON parser.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
 import threading
@@ -249,16 +250,80 @@ def collect_run_records(
 
 _write_lock = threading.Lock()
 
+# Budget enforcement drops record types in this order (cheapest loss
+# first): spans are per-operation and unbounded under load, CD rows are
+# per-iteration, phases are per-run. meta/env/metric records NEVER drop —
+# they are the summary a size-capped report exists to preserve.
+_DROP_ORDER = ("span", "coordinate_descent", "phase")
 
-def write_run_report(path: str, records: List[Dict[str, Any]]) -> None:
+
+def _budget_lines(
+    lines: List[str], kinds: List[str], max_bytes: int
+) -> List[str]:
+    """Trim serialized lines to ``max_bytes``, dropping droppable record
+    kinds oldest-first. Returns the surviving lines (original order)."""
+    total = sum(len(line) for line in lines)
+    if total <= max_bytes:
+        return lines
+    keep = [True] * len(lines)
+    dropped = 0
+    for kind in _DROP_ORDER:
+        if total <= max_bytes:
+            break
+        for i, k in enumerate(kinds):
+            if k == kind and keep[i]:
+                keep[i] = False
+                total -= len(lines[i])
+                dropped += 1
+                if total <= max_bytes:
+                    break
+    if dropped:
+        from photon_tpu.obs.metrics import registry
+
+        registry().counter("telemetry_records_dropped_total").inc(dropped)
+        logging.getLogger("photon_tpu").warning(
+            "run report over its %d-byte budget: dropped %d oldest "
+            "span/cd/phase records (summary records always kept)",
+            max_bytes, dropped,
+        )
+    return [line for i, line in enumerate(lines) if keep[i]]
+
+
+def write_run_report(
+    path: str,
+    records: List[Dict[str, Any]],
+    max_bytes: Optional[int] = None,
+) -> None:
     """Serialize records as JSONL (one validated, sanitized object per
-    line). Parent directories are created; the file is replaced whole."""
+    line). Parent directories are created; the write is atomic (tmp +
+    rename), so a reader polling mid-soak never sees a torn file.
+
+    ``max_bytes`` (default: ``PHOTON_TPU_TELEMETRY_MAX_BYTES`` env, else
+    unbounded) is the rotation budget: the previous report rotates to
+    ``<path>.1`` and, if the new snapshot alone exceeds the budget, the
+    oldest span records drop first (then coordinate-descent rows, then
+    phases) — meta/env/metric summary records are always kept, so a
+    long soak degrades telemetry granularity, never observability."""
+    if max_bytes is None:
+        env = os.environ.get("PHOTON_TPU_TELEMETRY_MAX_BYTES")
+        if env:
+            max_bytes = int(env)
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
-    with _write_lock, open(path, "w") as f:
-        for rec in records:
-            json.dump(rec, f, sort_keys=True)
-            f.write("\n")
+    lines = [json.dumps(rec, sort_keys=True) + "\n" for rec in records]
+    with _write_lock:
+        if max_bytes is not None and max_bytes > 0:
+            kinds = [rec.get("record") for rec in records]
+            lines = _budget_lines(lines, kinds, max_bytes)
+            if os.path.exists(path):
+                try:
+                    os.replace(path, path + ".1")
+                except OSError:
+                    pass  # rotation is best-effort; the write is not
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(lines)
+        os.replace(tmp, path)
 
 
 def finalize_run_report(
@@ -267,13 +332,14 @@ def finalize_run_report(
     emitter=None,
     trackers: Optional[List[Dict[str, Any]]] = None,
     run_id: Optional[str] = None,
+    max_bytes: Optional[int] = None,
 ) -> List[Dict[str, Any]]:
     """The driver-exit hook: collect, write (when ``path``), and emit one
     ``PhotonOptimizationLogEvent`` carrying the records (listeners get the
     same payload the file holds)."""
     records = collect_run_records(driver, run_id=run_id, trackers=trackers)
     if path:
-        write_run_report(path, records)
+        write_run_report(path, records, max_bytes=max_bytes)
     if emitter is not None:
         from photon_tpu.utils.events import optimization_log_event
 

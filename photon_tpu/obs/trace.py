@@ -22,12 +22,20 @@ across threads without any global ambient state leaking between runs.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Iterator, List, Optional
 
 SEP = "/"
+
+# Serving soaks record spans per micro-batch indefinitely; an unbounded
+# list is a slow memory leak. The collector keeps the NEWEST max_spans
+# (deque ring), counting what it sheds — the run report's byte budget
+# (obs/report.py) is the second line of defense.
+DEFAULT_MAX_SPANS = int(os.environ.get("PHOTON_TPU_TRACE_MAX_SPANS", 100_000))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,9 +64,13 @@ class Tracer:
     """Thread-safe span collector. One process-global instance backs the
     module-level helpers; tests may build private ones."""
 
-    def __init__(self):
+    def __init__(self, max_spans: Optional[int] = None):
         self._lock = threading.Lock()
-        self._spans: List[SpanRecord] = []
+        self.max_spans = DEFAULT_MAX_SPANS if max_spans is None else max_spans
+        self._spans: deque = deque(
+            maxlen=self.max_spans if self.max_spans > 0 else None
+        )
+        self.dropped_spans = 0
         self._local = threading.local()
         self._epoch = time.monotonic()
         self.epoch_unix_s = time.time()
@@ -121,6 +133,11 @@ class Tracer:
 
     def _append(self, rec: SpanRecord) -> None:
         with self._lock:
+            if (
+                self._spans.maxlen is not None
+                and len(self._spans) == self._spans.maxlen
+            ):
+                self.dropped_spans += 1  # ring full: deque sheds the oldest
             self._spans.append(rec)
 
     # -- introspection / lifecycle ----------------------------------------
@@ -136,6 +153,7 @@ class Tracer:
         open."""
         with self._lock:
             self._spans.clear()
+            self.dropped_spans = 0
             self._epoch = time.monotonic()
             self.epoch_unix_s = time.time()
 

@@ -1,6 +1,15 @@
 """Online GAME serving: micro-batched scoring, hot/cold entity residency,
 zero-downtime reload. See serve/engine.py for the composition."""
 
+from photon_tpu.serve.admission import (
+    BATCH,
+    INTERACTIVE,
+    AdmissionConfig,
+    AdmissionController,
+    QuotaExceededError,
+    TokenBucket,
+    parse_tenant_rates,
+)
 from photon_tpu.serve.batcher import (
     BackpressureError,
     DeadlineExceededError,
@@ -8,15 +17,30 @@ from photon_tpu.serve.batcher import (
     ScoreRequest,
 )
 from photon_tpu.serve.engine import ServeConfig, ServingEngine, load_engine
+from photon_tpu.serve.frontend import (
+    ScorerClient,
+    ScorerServer,
+    ServingFrontend,
+)
 from photon_tpu.serve.store import HotColdEntityStore
 
 __all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
     "BackpressureError",
+    "BATCH",
     "DeadlineExceededError",
     "HotColdEntityStore",
+    "INTERACTIVE",
     "MicroBatcher",
+    "QuotaExceededError",
     "ScoreRequest",
+    "ScorerClient",
+    "ScorerServer",
     "ServeConfig",
     "ServingEngine",
+    "ServingFrontend",
+    "TokenBucket",
     "load_engine",
+    "parse_tenant_rates",
 ]

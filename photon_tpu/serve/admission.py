@@ -1,0 +1,232 @@
+"""Per-tenant admission control for the serving front end.
+
+Multi-tenant fairness is a policy problem, not a kernel problem (Snap ML's
+lesson, PAPERS.md): a single abusive caller can destroy everyone's p99 long
+before the scorer saturates. This module decides — BEFORE a request touches
+the micro-batcher — whether a tenant may spend queue capacity, using two
+orthogonal mechanisms layered on the existing
+:class:`~photon_tpu.serve.batcher.BackpressureError` machinery:
+
+1. **Token-bucket QPS quotas.** Each tenant owns a bucket refilled at
+   ``qps`` tokens/s up to ``burst``; an empty bucket sheds the request with
+   :class:`QuotaExceededError` (a ``BackpressureError`` subclass, so every
+   existing 429 path keeps working unchanged while shed REASONS stay
+   distinguishable in metrics).
+2. **Priority classes.** ``interactive`` traffic may use the whole queue;
+   ``batch`` traffic is admitted only while queue depth is below
+   ``batch_queue_fraction`` of the cap, and the batcher may additionally
+   preempt queued batch-class requests when an interactive submit finds the
+   queue full — bulk backfill never starves latency-sensitive callers.
+
+All state lives in the single scorer process (the front-end workers hold no
+quota state), so quotas are globally consistent no matter how many HTTP
+workers fan requests in. The clock is injectable for deterministic tests.
+
+Telemetry: ``serve_tenant_requests_total{tenant,priority}``,
+``serve_tenant_shed_total{tenant,reason}`` and
+``serve_tenant_latency_s{tenant}`` flow through the obs/ registry and land
+in the run report / ``/healthz`` snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from photon_tpu.obs.metrics import registry
+from photon_tpu.serve.batcher import BackpressureError
+
+# Priority classes: plain strings on the wire (HTTP header / JSON field /
+# IPC frame) and in the batcher, so no enum crosses process boundaries.
+INTERACTIVE = "interactive"
+BATCH = "batch"
+PRIORITIES = (INTERACTIVE, BATCH)
+
+DEFAULT_TENANT = "default"
+
+
+class QuotaExceededError(BackpressureError):
+    """The tenant exhausted its admission budget. Subclasses
+    ``BackpressureError`` so the HTTP layer's existing 429 mapping applies;
+    ``reason`` distinguishes quota sheds from capacity sheds in metrics."""
+
+    def __init__(self, message: str, tenant: str, reason: str = "quota"):
+        super().__init__(message)
+        self.tenant = tenant
+        self.reason = reason
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``
+    capacity. Monotonic, injectable clock; thread-safe (one lock per
+    tenant bucket — admission is cheap, contention is per-tenant)."""
+
+    def __init__(
+        self,
+        rate: float,
+        burst: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if rate <= 0:
+            raise ValueError(f"token bucket rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else max(self.rate, 1.0)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            now = self._clock()
+            return min(self.burst, self._tokens + (now - self._last) * self.rate)
+
+
+def parse_tenant_rates(spec: Optional[str]) -> Dict[str, float]:
+    """CLI helper: ``"tenantA=5,tenantB=250"`` → ``{"tenantA": 5.0, ...}``."""
+    out: Dict[str, float] = {}
+    if not spec:
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"tenant rate spec entry {part!r} must look like name=qps"
+            )
+        name, rate = part.split("=", 1)
+        out[name.strip()] = float(rate)
+    return out
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    """Quota policy. ``default_qps=None`` means unknown tenants are
+    unlimited (quota-exempt) — quotas then apply only to tenants named in
+    ``tenant_qps``. Burst defaults to ``max(qps, 1)`` per tenant."""
+
+    default_qps: Optional[float] = None
+    default_burst: Optional[float] = None
+    tenant_qps: Dict[str, float] = dataclasses.field(default_factory=dict)
+    tenant_burst: Dict[str, float] = dataclasses.field(default_factory=dict)
+    batch_queue_fraction: float = 0.5  # batch admitted below this depth
+
+    def enabled(self) -> bool:
+        return self.default_qps is not None or bool(self.tenant_qps)
+
+
+class AdmissionController:
+    """Admission decisions + per-tenant accounting for one scorer process.
+
+    ``admit`` raises :class:`QuotaExceededError` (→ HTTP 429) or returns
+    None; it never blocks — shedding is an exception on the caller's
+    thread, same discipline as the batcher's backpressure."""
+
+    def __init__(
+        self,
+        config: Optional[AdmissionConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or AdmissionConfig()
+        self._clock = clock
+        self._buckets: Dict[str, Optional[TokenBucket]] = {}
+        self._lock = threading.Lock()
+        self._admitted: Dict[str, int] = {}
+        self._shed: Dict[str, int] = {}
+
+    def _bucket(self, tenant: str) -> Optional[TokenBucket]:
+        with self._lock:
+            if tenant not in self._buckets:
+                cfg = self.config
+                rate = cfg.tenant_qps.get(tenant, cfg.default_qps)
+                if rate is None:
+                    self._buckets[tenant] = None  # quota-exempt
+                else:
+                    self._buckets[tenant] = TokenBucket(
+                        rate,
+                        cfg.tenant_burst.get(tenant, cfg.default_burst),
+                        clock=self._clock,
+                    )
+            return self._buckets[tenant]
+
+    def _record_shed(self, tenant: str, reason: str) -> None:
+        registry().counter(
+            "serve_tenant_shed_total", tenant=tenant, reason=reason
+        ).inc()
+        with self._lock:
+            self._shed[tenant] = self._shed.get(tenant, 0) + 1
+
+    def admit(
+        self,
+        tenant: Optional[str],
+        priority: str = INTERACTIVE,
+        queue_depth: int = 0,
+        queue_cap: int = 0,
+    ) -> None:
+        """Charge one request against ``tenant``'s budget. Batch-class
+        traffic is additionally refused while the queue is already
+        ``batch_queue_fraction`` full — that headroom is reserved for
+        interactive callers."""
+        tenant = tenant or DEFAULT_TENANT
+        registry().counter(
+            "serve_tenant_requests_total", tenant=tenant, priority=priority
+        ).inc()
+        if (
+            priority == BATCH
+            and queue_cap > 0
+            and queue_depth >= self.config.batch_queue_fraction * queue_cap
+        ):
+            self._record_shed(tenant, "batch_capacity")
+            raise QuotaExceededError(
+                f"batch-class request from tenant {tenant!r} shed: queue "
+                f"depth {queue_depth} is past the batch admission share "
+                f"({self.config.batch_queue_fraction:.0%} of {queue_cap})",
+                tenant,
+                reason="batch_capacity",
+            )
+        bucket = self._bucket(tenant)
+        if bucket is not None and not bucket.try_acquire():
+            self._record_shed(tenant, "quota")
+            raise QuotaExceededError(
+                f"tenant {tenant!r} exceeded its {bucket.rate:g} qps quota "
+                f"(burst {bucket.burst:g}); request shed",
+                tenant,
+            )
+        with self._lock:
+            self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+
+    def observe_latency(self, tenant: Optional[str], latency_s: float) -> None:
+        registry().histogram(
+            "serve_tenant_latency_s", tenant=tenant or DEFAULT_TENANT
+        ).observe(latency_s)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Per-tenant admission state for ``/healthz`` and the soak bench."""
+        with self._lock:
+            tenants = set(self._admitted) | set(self._shed) | set(self._buckets)
+            out = {}
+            for t in sorted(tenants):
+                bucket = self._buckets.get(t)
+                out[t] = dict(
+                    admitted=self._admitted.get(t, 0),
+                    shed=self._shed.get(t, 0),
+                    qps_limit=bucket.rate if bucket is not None else None,
+                    burst=bucket.burst if bucket is not None else None,
+                )
+            return out

@@ -63,6 +63,7 @@ class _Pending:
     future: Future
     enqueue_t: float
     deadline_t: Optional[float]
+    priority: str = "interactive"
 
 
 class MicroBatcher:
@@ -101,33 +102,60 @@ class MicroBatcher:
     # -- producer side -----------------------------------------------------
 
     def submit(
-        self, request: ScoreRequest, deadline_s: Optional[float] = None
+        self,
+        request: ScoreRequest,
+        deadline_s: Optional[float] = None,
+        priority: str = "interactive",
     ) -> Future:
         """Enqueue one request; returns a Future resolving to its float
         score. ``deadline_s`` is a relative budget (seconds from now)
-        covering queue wait + scoring."""
+        covering queue wait + scoring. ``priority`` is the admission class:
+        when the queue is at cap, an interactive submit PREEMPTS the
+        newest queued batch-class request (which fails with
+        ``BackpressureError``) instead of being shed itself — bulk
+        backfill yields capacity to latency-sensitive traffic."""
         reg = registry()
         now = time.monotonic()
         fut: Future = Future()
+        victim: Optional[_Pending] = None
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"batcher {self.name!r} is closed")
             if len(self._pending) >= self.queue_cap:
-                reg.counter("serve_requests_shed_total").inc()
-                raise BackpressureError(
-                    f"serve queue depth {len(self._pending)} at cap "
-                    f"{self.queue_cap}; request shed"
-                )
+                if priority != "batch":
+                    for i in range(len(self._pending) - 1, -1, -1):
+                        if self._pending[i].priority == "batch":
+                            victim = self._pending[i]
+                            del self._pending[i]
+                            reg.counter(
+                                "serve_requests_preempted_total"
+                            ).inc()
+                            break
+                if victim is None:
+                    reg.counter("serve_requests_shed_total").inc()
+                    raise BackpressureError(
+                        f"serve queue depth {len(self._pending)} at cap "
+                        f"{self.queue_cap}; request shed"
+                    )
             self._pending.append(
                 _Pending(
                     request,
                     fut,
                     now,
                     None if deadline_s is None else now + float(deadline_s),
+                    priority,
                 )
             )
             reg.counter("serve_requests_total").inc()
             self._cond.notify_all()
+        if victim is not None:
+            # Outside the lock: done-callbacks run inline on set_exception.
+            victim.future.set_exception(
+                BackpressureError(
+                    "batch-class request preempted by interactive traffic "
+                    "at full queue; retry with backoff"
+                )
+            )
         return fut
 
     @property

@@ -58,6 +58,11 @@ from photon_tpu.estimators.game_transformer import GameTransformer
 from photon_tpu.models.game import GameModel
 from photon_tpu.obs.metrics import registry
 from photon_tpu.obs.trace import tracer
+from photon_tpu.serve.admission import (
+    INTERACTIVE,
+    AdmissionConfig,
+    AdmissionController,
+)
 from photon_tpu.serve.batcher import MicroBatcher, ScoreRequest
 from photon_tpu.serve.store import HotColdEntityStore
 from photon_tpu.utils import faults
@@ -79,6 +84,7 @@ class ServeConfig:
     default_deadline_ms: Optional[float] = None  # per-request unless given
     breaker_threshold: int = 3  # consecutive resolve failures to trip
     breaker_cooldown_s: float = 30.0  # open duration before half-open probe
+    admission: Optional[AdmissionConfig] = None  # per-tenant quotas/classes
 
 
 class _Breaker:
@@ -159,6 +165,10 @@ class ServingEngine:
         # Per-RE-type circuit breakers: engine-owned (they outlive reloads —
         # a flapping store should stay degraded across a model swap).
         self._breakers: Dict[str, _Breaker] = {}
+        # Admission lives HERE (the one device-owning process), never in
+        # front-end workers — quota state must be globally consistent no
+        # matter how many processes fan requests in.
+        self.admission = AdmissionController(self.config.admission)
         self._state = self._build_state(model, model_version)
         self.batcher = MicroBatcher(
             self._score_batch,
@@ -346,11 +356,32 @@ class ServingEngine:
     # -- public API ---------------------------------------------------------
 
     def submit(
-        self, request: ScoreRequest, deadline_s: Optional[float] = None
+        self,
+        request: ScoreRequest,
+        deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: str = INTERACTIVE,
     ):
+        """Admit (quota + priority class), then enqueue. Shed requests
+        raise on THIS thread (``QuotaExceededError``/``BackpressureError``,
+        both → HTTP 429); admitted requests return a Future and report
+        their end-to-end latency into ``serve_tenant_latency_s``."""
         if deadline_s is None and self.config.default_deadline_ms is not None:
             deadline_s = self.config.default_deadline_ms / 1000.0
-        return self.batcher.submit(request, deadline_s)
+        self.admission.admit(
+            tenant,
+            priority,
+            queue_depth=self.batcher.queue_depth,
+            queue_cap=self.config.queue_cap,
+        )
+        t0 = time.monotonic()
+        fut = self.batcher.submit(request, deadline_s, priority=priority)
+        fut.add_done_callback(
+            lambda f: self.admission.observe_latency(
+                tenant, time.monotonic() - t0
+            )
+        )
+        return fut
 
     def score(
         self,
@@ -358,11 +389,15 @@ class ServingEngine:
         entity_ids: Optional[Dict[str, object]] = None,
         offset: float = 0.0,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: str = INTERACTIVE,
     ) -> float:
         """Synchronous convenience wrapper: one request, blocking."""
         return self.submit(
             ScoreRequest(features, dict(entity_ids or {}), offset),
             deadline_s,
+            tenant=tenant,
+            priority=priority,
         ).result()
 
     @property
@@ -426,6 +461,7 @@ class ServingEngine:
             },
             reload_failures=self._reload_failures,
             last_reload_error=self._last_reload_error,
+            tenants=self.admission.snapshot(),
         )
 
     def close(self, drain: bool = True) -> None:

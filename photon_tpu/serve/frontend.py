@@ -1,0 +1,801 @@
+"""Multi-process serving front end: N HTTP workers, one device-owning scorer.
+
+The stdlib ``ThreadingHTTPServer`` front end shares one GIL with the jitted
+scorer — fine for correctness, fatal for sustained p99 once request
+parsing competes with dispatch (the carried-over risk in the serving PR,
+ROADMAP "Serving front end that survives real traffic"). This module splits
+the two across PROCESSES:
+
+- **Workers** (N of them) accept connections on a SHARED listening socket
+  (created before fork, so the kernel load-balances ``accept`` across
+  processes), parse/validate HTTP+JSON, and forward each request over a
+  Unix-domain socket to the scorer. Workers never import jax — they are
+  pure stdlib and fork-safe by construction.
+- **Scorer** (exactly one, the parent) owns the device: admission →
+  ``MicroBatcher`` → ``ServingEngine``, the SAME path the in-process server
+  uses, byte for byte. Requests from all workers co-batch in the one
+  flusher, so multi-process serving keeps the zero-retrace and bit-parity
+  contracts of the single-process engine.
+
+Fork discipline (the part that is easy to get wrong): workers are forked
+while the parent is still single-threaded and has NOT initialized the JAX
+backend — forking after backend init duplicates locked mutexes and device
+handles into children. ``ServingFrontend.fork_workers()`` must therefore
+run before ``load_engine``; workers retry-connect to the scorer socket
+until the (slow, warm-up-bound) parent starts listening.
+
+Wire protocol: 4-byte big-endian length + UTF-8 JSON per frame, one
+id-correlated request/response stream per worker connection. Responses
+complete out of order (a shed answers before a queued score), which is what
+lets one worker pipeline hundreds of in-flight requests over one socket.
+
+Errors cross the boundary as ``{code, kind, error}`` payloads and are
+re-raised client-side as the SAME exception types the engine raises
+(``QuotaExceededError``/``BackpressureError`` → 429,
+``DeadlineExceededError`` → 504, ``ValueError`` → 400), so the HTTP layer
+has exactly one classification function for both deployment shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import shutil
+import socket
+import socketserver
+import struct
+import tempfile
+import threading
+import time
+import traceback
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from photon_tpu.serve.admission import INTERACTIVE, PRIORITIES, QuotaExceededError
+from photon_tpu.serve.batcher import (
+    BackpressureError,
+    DeadlineExceededError,
+    ScoreRequest,
+)
+
+logger = logging.getLogger("photon_tpu")
+
+_LEN = struct.Struct(">I")
+MAX_FRAME_BYTES = 64 << 20
+
+
+# ---------------------------------------------------------------------------
+# Request parsing + error classification (shared by both deployment shapes)
+# ---------------------------------------------------------------------------
+
+
+def request_from_json(obj: dict) -> ScoreRequest:
+    if not isinstance(obj, dict) or "features" not in obj:
+        raise ValueError("request must be a JSON object with 'features'")
+    return ScoreRequest(
+        features=dict(obj["features"]),
+        entity_ids=dict(obj.get("entityIds", {})),
+        offset=float(obj.get("offset", 0.0)),
+        uid=obj.get("uid"),
+    )
+
+
+def classify_exception(exc: BaseException):
+    """(http_code, kind) for one request failure. ``kind`` separates the
+    shed REASONS that share a status code — quota sheds and queue
+    backpressure both 429, but tenants (and the soak bench) need to tell
+    them apart."""
+    kind = getattr(exc, "http_kind", None)
+    if isinstance(exc, QuotaExceededError):
+        return 429, kind or getattr(exc, "reason", "quota")
+    if isinstance(exc, BackpressureError):
+        return 429, kind or "backpressure"
+    if isinstance(exc, (DeadlineExceededError, FutureTimeoutError)):
+        return 504, kind or "deadline"
+    if isinstance(exc, (ValueError, KeyError, json.JSONDecodeError)):
+        return 400, kind or "bad_request"
+    return 500, kind or "internal"
+
+
+def _exception_from_payload(msg: dict) -> BaseException:
+    """Rebuild the engine's exception type from a scorer error frame, so
+    worker-side HTTP mapping is identical to the in-process path."""
+    code = int(msg.get("code", 500))
+    kind = msg.get("kind", "internal")
+    text = str(msg.get("error", "scorer error"))
+    exc: BaseException
+    if code == 429:
+        if kind in ("quota", "batch_capacity"):
+            exc = QuotaExceededError(
+                text, msg.get("tenant", "?"), reason=kind
+            )
+        else:
+            exc = BackpressureError(text)
+    elif code == 504:
+        exc = DeadlineExceededError(text)
+    elif code == 400:
+        exc = ValueError(text)
+    else:
+        exc = RuntimeError(text)
+    exc.http_kind = kind  # preserve the original classification verbatim
+    return exc
+
+
+def score_jsonl(body: bytes, submit, result_timeout_s: Optional[float] = None):
+    """``/v1/score-batch`` core: submit every parseable line FIRST (they
+    co-batch in the flusher), then collect in order. Each line resolves
+    independently: ``{"score": s}`` on success, else ``{"error", "code",
+    "kind"}`` — a malformed line is a per-line 400, never conflated with a
+    429 shed (they used to share one except clause)."""
+    futures: List[object] = []
+    for line in body.splitlines():
+        if not line.strip():
+            continue
+        try:
+            futures.append(submit(json.loads(line)))
+        except Exception as exc:  # noqa: BLE001 — per-line failure
+            futures.append(exc)
+    out = []
+    for f in futures:
+        if isinstance(f, BaseException):
+            code, kind = classify_exception(f)
+            out.append({"error": str(f), "code": code, "kind": kind})
+        else:
+            try:
+                res = f.result(result_timeout_s)
+                out.append({"score": res["score"]})
+            except Exception as exc:  # noqa: BLE001 — per-line failure
+                code, kind = classify_exception(exc)
+                out.append({"error": str(exc), "code": code, "kind": kind})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Framed IPC
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
+    data = json.dumps(obj).encode()
+    with lock:
+        sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(f"IPC frame of {length} bytes exceeds cap")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return json.loads(payload.decode())
+
+
+# ---------------------------------------------------------------------------
+# Scorer side (the one device-owning process)
+# ---------------------------------------------------------------------------
+
+
+class ScorerServer:
+    """Accepts worker connections on a Unix socket and executes ops against
+    the engine. Per connection: one reader thread (parses frames, submits)
+    and one writer thread (serializes responses from a queue) — responses
+    complete out of order via the engine futures' done-callbacks, so a
+    single connection carries arbitrarily many in-flight requests."""
+
+    def __init__(self, engine, socket_path: str):
+        self.engine = engine
+        self.socket_path = socket_path
+        self._sock: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(128)
+        t = threading.Thread(
+            target=self._accept_loop, name="scorer-accept", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="scorer-conn", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        out: "queue.Queue[Optional[dict]]" = queue.Queue()
+        wlock = threading.Lock()
+
+        def _writer() -> None:
+            while True:
+                msg = out.get()
+                if msg is None:
+                    return
+                try:
+                    _send_frame(conn, msg, wlock)
+                except OSError:
+                    return  # worker went away; reader notices EOF too
+
+        wt = threading.Thread(target=_writer, name="scorer-write", daemon=True)
+        wt.start()
+        try:
+            while True:
+                try:
+                    msg = _recv_frame(conn)
+                except (OSError, ValueError):
+                    break
+                if msg is None:
+                    break
+                self._dispatch(msg, out)
+        finally:
+            out.put(None)
+            wt.join(timeout=5.0)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _error_payload(self, rid, exc: BaseException) -> dict:
+        code, kind = classify_exception(exc)
+        payload = dict(
+            id=rid, ok=False, code=code, kind=kind, error=str(exc)
+        )
+        if isinstance(exc, QuotaExceededError):
+            payload["tenant"] = exc.tenant
+        return payload
+
+    def _dispatch(self, msg: dict, out: "queue.Queue") -> None:
+        rid = msg.get("id")
+        op = msg.get("op")
+        try:
+            if op == "score":
+                self._op_score(rid, msg, out)
+            elif op == "stats":
+                out.put(dict(id=rid, ok=True, result=self.engine.stats()))
+            elif op == "reload":
+                # Off-thread: a reload warms a whole model generation;
+                # this connection's scores must keep flowing meanwhile.
+                threading.Thread(
+                    target=self._op_reload, args=(rid, msg, out),
+                    name="scorer-reload", daemon=True,
+                ).start()
+            elif op == "ping":
+                out.put(dict(id=rid, ok=True, result="pong"))
+            else:
+                raise ValueError(f"unknown scorer op {op!r}")
+        except Exception as exc:  # noqa: BLE001 — per-request failure
+            out.put(self._error_payload(rid, exc))
+
+    def _op_score(self, rid, msg: dict, out: "queue.Queue") -> None:
+        req = request_from_json(msg.get("request") or {})
+        fut = self.engine.submit(
+            req,
+            tenant=msg.get("tenant"),
+            priority=msg.get("priority") or INTERACTIVE,
+        )
+
+        def _done(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                out.put(self._error_payload(rid, exc))
+            else:
+                out.put(dict(
+                    id=rid, ok=True,
+                    result=dict(
+                        score=f.result(),
+                        modelVersion=self.engine.model_version,
+                    ),
+                ))
+
+        fut.add_done_callback(_done)
+
+    def _op_reload(self, rid, msg: dict, out: "queue.Queue") -> None:
+        try:
+            from photon_tpu.io.model_io import load_game_model
+
+            model_dir = msg.get("modelDir")
+            if not model_dir:
+                raise ValueError("reload needs {'modelDir': path}")
+            model = load_game_model(
+                model_dir, self.engine._index_maps,
+                self.engine._entity_indexes, to_device=False,
+            )
+            info = self.engine.reload(
+                model, msg.get("modelVersion") or model_dir
+            )
+            out.put(dict(id=rid, ok=True, result=info))
+        except Exception as exc:  # noqa: BLE001 — per-request failure
+            out.put(self._error_payload(rid, exc))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = list(self._conns)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Worker side
+# ---------------------------------------------------------------------------
+
+
+class ScorerClient:
+    """One worker's connection to the scorer: id-correlated async frames.
+    ``submit_score`` returns a Future resolving to the scorer's result dict
+    (or raising the reconstructed engine exception); a lost connection
+    fails every in-flight future with ``ConnectionError``."""
+
+    def __init__(self, socket_path: str, connect_timeout_s: float = 120.0):
+        deadline = time.monotonic() + connect_timeout_s
+        last_err: Optional[BaseException] = None
+        while True:
+            try:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.connect(socket_path)
+                break
+            except OSError as exc:
+                last_err = exc
+                sock.close()
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"scorer socket {socket_path} not reachable after "
+                        f"{connect_timeout_s:.0f}s: {last_err}"
+                    ) from last_err
+                # The scorer is still warming the model; keep retrying.
+                time.sleep(0.05)
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._next_id = 0
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="scorer-client-read", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = _recv_frame(self._sock)
+                if msg is None:
+                    break
+                with self._plock:
+                    fut = self._pending.pop(msg.get("id"), None)
+                if fut is None:
+                    continue
+                if msg.get("ok"):
+                    fut.set_result(msg.get("result"))
+                else:
+                    fut.set_exception(_exception_from_payload(msg))
+        except (OSError, ValueError):
+            pass
+        finally:
+            with self._plock:
+                pending, self._pending = self._pending, {}
+            for fut in pending.values():
+                fut.set_exception(
+                    ConnectionError("scorer connection lost")
+                )
+
+    def request(self, op: str, **payload) -> Future:
+        fut: Future = Future()
+        with self._plock:
+            if self._closed:
+                raise ConnectionError("scorer client closed")
+            rid = self._next_id
+            self._next_id += 1
+            self._pending[rid] = fut
+        try:
+            _send_frame(
+                self._sock, dict(id=rid, op=op, **payload), self._wlock
+            )
+        except OSError as exc:
+            with self._plock:
+                self._pending.pop(rid, None)
+            raise ConnectionError(f"scorer connection lost: {exc}") from exc
+        return fut
+
+    def submit_score(
+        self,
+        raw_request: dict,
+        tenant: Optional[str] = None,
+        priority: str = INTERACTIVE,
+    ) -> Future:
+        return self.request(
+            "score", request=raw_request, tenant=tenant, priority=priority
+        )
+
+    def call(self, op: str, timeout_s: float = 30.0, **payload):
+        return self.request(op, **payload).result(timeout_s)
+
+    def close(self) -> None:
+        with self._plock:
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer (shared by in-process and multi-process deployments)
+# ---------------------------------------------------------------------------
+
+
+class LocalBackend:
+    """Direct engine access — the single-process deployment shape."""
+
+    def __init__(self, engine, result_timeout_s: float = 120.0):
+        self.engine = engine
+        self.result_timeout_s = result_timeout_s
+
+    def submit(
+        self, raw_request: dict, tenant: Optional[str], priority: str
+    ) -> Future:
+        src = self.engine.submit(
+            request_from_json(raw_request), tenant=tenant, priority=priority
+        )
+        dst: Future = Future()
+
+        def _done(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                dst.set_exception(exc)
+            else:
+                dst.set_result(dict(
+                    score=f.result(),
+                    modelVersion=self.engine.model_version,
+                ))
+
+        src.add_done_callback(_done)
+        return dst
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def reload(self, body: dict) -> dict:
+        from photon_tpu.io.model_io import load_game_model
+
+        model_dir = body.get("modelDir")
+        if not model_dir:
+            raise ValueError("reload needs {'modelDir': path}")
+        # Index maps / entity indexes are generation-stable artifacts
+        # (the training pipeline reuses them across model refreshes);
+        # only the coefficient tables swap.
+        model = load_game_model(
+            model_dir, self.engine._index_maps, self.engine._entity_indexes,
+            to_device=False,
+        )
+        return self.engine.reload(model, body.get("modelVersion") or model_dir)
+
+
+class RemoteBackend:
+    """Scorer access over the IPC channel — the worker deployment shape."""
+
+    def __init__(self, client: ScorerClient, worker_index: int = 0,
+                 result_timeout_s: float = 120.0):
+        self.client = client
+        self.worker_index = worker_index
+        self.result_timeout_s = result_timeout_s
+
+    def submit(
+        self, raw_request: dict, tenant: Optional[str], priority: str
+    ) -> Future:
+        return self.client.submit_score(raw_request, tenant, priority)
+
+    def stats(self) -> dict:
+        stats = self.client.call("stats", timeout_s=30.0)
+        stats["worker"] = self.worker_index
+        stats["workerPid"] = os.getpid()
+        return stats
+
+    def reload(self, body: dict) -> dict:
+        # A reload builds + warms a whole generation; give it real time.
+        return self.client.call(
+            "reload", timeout_s=600.0,
+            modelDir=body.get("modelDir"),
+            modelVersion=body.get("modelVersion"),
+        )
+
+
+def make_http_handler(backend):
+    """The ONE endpoint implementation, parameterized by backend — local
+    engine or remote scorer. Tenant comes from the ``X-Tenant`` header (or
+    a per-request ``tenant`` field), priority from ``X-Priority`` /
+    ``priority`` (``interactive`` default, ``batch`` for bulk callers)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # Idle keep-alive connections release their thread after this, so
+        # worker drain (server_close joins handler threads) can finish.
+        timeout = 5.0
+
+        def log_message(self, fmt, *args):  # route through logging
+            logger.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, payload: bytes, ctype="application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _reply_json(self, code: int, obj) -> None:
+            self._reply(code, (json.dumps(obj) + "\n").encode())
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(length)
+
+        def _tenant_priority(self, obj: Optional[dict] = None):
+            tenant = self.headers.get("X-Tenant")
+            priority = self.headers.get("X-Priority")
+            if isinstance(obj, dict):
+                tenant = obj.get("tenant", tenant)
+                priority = obj.get("priority", priority)
+            priority = priority or INTERACTIVE
+            if priority not in PRIORITIES:
+                raise ValueError(
+                    f"priority must be one of {PRIORITIES}, got {priority!r}"
+                )
+            return tenant, priority
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply_json(200, backend.stats())
+            else:
+                self._reply_json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            try:
+                if self.path == "/v1/score":
+                    self._score_one()
+                elif self.path == "/v1/score-batch":
+                    self._score_jsonl()
+                elif self.path == "/v1/reload":
+                    body = self._body()
+                    info = backend.reload(json.loads(body) if body else {})
+                    self._reply_json(200, info)
+                else:
+                    self._reply_json(404, {"error": f"no route {self.path}"})
+            except Exception as exc:  # noqa: BLE001 — classified below
+                code, kind = classify_exception(exc)
+                if code == 500:
+                    logger.exception("request failed")
+                payload = {"error": str(exc), "kind": kind}
+                tenant = getattr(exc, "tenant", None)
+                if tenant is not None:
+                    payload["tenant"] = tenant
+                self._reply_json(code, payload)
+
+        def _score_one(self):
+            obj = json.loads(self._body())
+            tenant, priority = self._tenant_priority(obj)
+            res = backend.submit(obj, tenant, priority).result(
+                backend.result_timeout_s
+            )
+            self._reply_json(200, res)
+
+        def _score_jsonl(self):
+            tenant, priority = self._tenant_priority()
+            out = score_jsonl(
+                self._body(),
+                lambda obj: backend.submit(obj, tenant, priority),
+                result_timeout_s=backend.result_timeout_s,
+            )
+            payload = "".join(json.dumps(o) + "\n" for o in out).encode()
+            self._reply(200, payload, ctype="application/jsonl")
+
+    return Handler
+
+
+class _InheritedSocketHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer over an already-bound, already-listening socket
+    (the pre-fork shared listener). ``daemon_threads=False`` +
+    ``block_on_close`` makes ``server_close`` JOIN in-flight handler
+    threads — that's the worker-side drain."""
+
+    daemon_threads = False
+
+    def __init__(self, sock: socket.socket, handler):
+        socketserver.BaseServer.__init__(self, sock.getsockname()[:2], handler)
+        self.socket = sock
+        host, port = sock.getsockname()[:2]
+        self.server_name = host
+        self.server_port = port
+
+
+def worker_main(
+    listen_sock: socket.socket,
+    scorer_path: str,
+    worker_index: int,
+    connect_timeout_s: float = 120.0,
+) -> None:
+    """Body of one forked HTTP worker. Blocks until SIGTERM/SIGINT, then
+    drains in-flight requests and returns. Never imports jax."""
+    client = ScorerClient(scorer_path, connect_timeout_s=connect_timeout_s)
+    backend = RemoteBackend(client, worker_index=worker_index)
+    server = _InheritedSocketHTTPServer(listen_sock, make_http_handler(backend))
+
+    import signal as _signal
+
+    def _stop(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    _signal.signal(_signal.SIGTERM, _stop)
+    _signal.signal(_signal.SIGINT, _stop)
+    logger.info("serve worker %d up (pid %d)", worker_index, os.getpid())
+    try:
+        server.serve_forever(poll_interval=0.1)
+    finally:
+        server.server_close()  # joins in-flight handler threads
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# Parent-side orchestration
+# ---------------------------------------------------------------------------
+
+
+class ServingFrontend:
+    """Pre-fork lifecycle for the multi-process deployment.
+
+    Call order matters and is asserted: ``__init__`` (bind the shared
+    listener) → ``fork_workers()`` (parent still single-threaded, NO jax
+    yet) → build the engine → ``start_scorer(engine)`` → serve →
+    ``shutdown()`` (SIGTERM workers first so admission stops, then drain
+    the engine)."""
+
+    def __init__(self, host: str, port: int, num_workers: int,
+                 backlog: int = 128):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.num_workers = int(num_workers)
+        self._listen_sock = socket.create_server(
+            (host, port), backlog=backlog
+        )
+        self.host, self.port = self._listen_sock.getsockname()[:2]
+        self._scorer_dir = tempfile.mkdtemp(prefix="photon-serve-")
+        self.scorer_path = os.path.join(self._scorer_dir, "scorer.sock")
+        self.pids: List[int] = []
+        self._live: Dict[int, bool] = {}
+        self.worker_exits: Dict[int, int] = {}
+        self.scorer: Optional[ScorerServer] = None
+        self._forked = False
+
+    def fork_workers(self) -> None:
+        """Fork the HTTP workers. MUST run before the parent touches jax
+        (fork duplicates only the calling thread — a forked copy of an
+        initialized backend inherits locked mutexes and dead threads)."""
+        assert not self._forked, "workers already forked"
+        self._forked = True
+        for widx in range(self.num_workers):
+            pid = os.fork()
+            if pid == 0:
+                code = 0
+                try:
+                    worker_main(self._listen_sock, self.scorer_path, widx)
+                except BaseException:  # noqa: BLE001 — report, then die
+                    traceback.print_exc()
+                    code = 1
+                finally:
+                    os._exit(code)
+            self.pids.append(pid)
+            self._live[pid] = True
+        self._listen_sock.close()  # only workers accept
+
+    def start_scorer(self, engine) -> None:
+        self.scorer = ScorerServer(engine, self.scorer_path)
+        self.scorer.start()
+
+    def poll_workers(self) -> List[int]:
+        """Reap any workers that died; returns the pids reaped this call.
+        A dead worker is logged and counted — the surviving workers keep
+        accepting (the shared listener load-balances around the gap)."""
+        from photon_tpu.obs.metrics import registry
+
+        reaped = []
+        for pid in self.pids:
+            if not self._live.get(pid):
+                continue
+            try:
+                done, status = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                done = pid
+                status = 0
+            if done == pid:
+                self._live[pid] = False
+                code = os.waitstatus_to_exitcode(status)
+                self.worker_exits[pid] = code
+                reaped.append(pid)
+                registry().counter("serve_worker_exits_total").inc()
+                logger.warning(
+                    "serve worker pid %d exited with code %s "
+                    "(%d/%d workers remain)",
+                    pid, code, self.live_workers(), self.num_workers,
+                )
+        return reaped
+
+    def live_workers(self) -> int:
+        return sum(1 for alive in self._live.values() if alive)
+
+    def shutdown(self, timeout_s: float = 15.0) -> Dict[int, int]:
+        """Drain in order: workers first (no new admissions), then the IPC
+        server, leaving the caller to drain the engine last."""
+        from photon_tpu.utils.shutdown import terminate_children
+
+        live = [pid for pid in self.pids if self._live.get(pid)]
+        exits = terminate_children(live, timeout_s=timeout_s)
+        for pid, code in exits.items():
+            self._live[pid] = False
+            self.worker_exits[pid] = code
+        if self.scorer is not None:
+            self.scorer.close()
+        shutil.rmtree(self._scorer_dir, ignore_errors=True)
+        return exits

@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os as _os
 import signal as _signal
 import threading
-from typing import Optional
+import time as _time
+from typing import Dict, Iterable, Optional
 
 
 class GracefulShutdown(Exception):
@@ -36,6 +38,60 @@ def shutdown_requested() -> Optional[int]:
     """Signum of a received SIGTERM/SIGINT inside :func:`handle_termination`,
     else None."""
     return _TERM_STATE["signum"]
+
+
+def terminate_children(
+    pids: Iterable[int],
+    timeout_s: float = 10.0,
+    poll_s: float = 0.05,
+) -> Dict[int, int]:
+    """Graceful multi-process drain: SIGTERM every child, wait up to
+    ``timeout_s`` for ALL to exit (polling ``waitpid(WNOHANG)``), then
+    SIGKILL stragglers. Returns {pid: exit code} (negative = killed by
+    signal, per ``waitstatus_to_exitcode``). Safe against children that
+    already died — ESRCH/ECHILD are treated as 'gone'."""
+    log = logging.getLogger("photon_tpu")
+    pending = {}
+    exits: Dict[int, int] = {}
+    for pid in pids:
+        try:
+            _os.kill(pid, _signal.SIGTERM)
+            pending[pid] = True
+        except ProcessLookupError:
+            pending[pid] = True  # already dead; still needs reaping
+    deadline = _time.monotonic() + timeout_s
+    while pending:
+        for pid in list(pending):
+            try:
+                done, status = _os.waitpid(pid, _os.WNOHANG)
+            except ChildProcessError:
+                exits[pid] = 0  # reaped elsewhere (or not our child)
+                del pending[pid]
+                continue
+            if done == pid:
+                exits[pid] = _os.waitstatus_to_exitcode(status)
+                del pending[pid]
+        if not pending:
+            break
+        if _time.monotonic() >= deadline:
+            for pid in list(pending):
+                log.warning(
+                    "child pid %d ignored SIGTERM for %.1fs; escalating "
+                    "to SIGKILL", pid, timeout_s,
+                )
+                try:
+                    _os.kill(pid, _signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                try:
+                    _, status = _os.waitpid(pid, 0)
+                    exits[pid] = _os.waitstatus_to_exitcode(status)
+                except ChildProcessError:
+                    exits[pid] = 0
+                del pending[pid]
+            break
+        _time.sleep(poll_s)
+    return exits
 
 
 @contextlib.contextmanager

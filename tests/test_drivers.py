@@ -72,6 +72,9 @@ def test_game_training_and_scoring_drivers(fixture_dir, tmp_path):
     assert (out / "best" / "model-metadata.json").exists()
     assert (out / "index-map-globalShard.json").exists()
     assert (out / "entity-index-userId.json").exists()
+    # Publication contract: the fsync'd LATEST pointer names the final
+    # generation, so a polling game_serving picks the model up unattended.
+    assert (out / "LATEST").read_text().strip() == "best"
 
     # Scoring driver consumes the training output.
     score_out = tmp_path / "scores"
@@ -147,6 +150,7 @@ def test_legacy_glm_driver_libsvm(tmp_path):
     # Best model by AUC present + text model files written.
     assert any(f.startswith("model-lambda-") for f in os.listdir(out))
     assert (out / "best" / "model-metadata.json").exists()
+    assert (out / "LATEST").read_text().strip() == "best"
     aucs = [m["validation"]["Area under ROC"] for m in summary["models"]]
     assert max(aucs) > 0.75
 

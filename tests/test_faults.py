@@ -598,3 +598,66 @@ def test_breaker_degrades_to_fe_only_then_recovers():
         assert not st["degraded"] and st["degraded_re_types"] == []
     finally:
         eng.close()
+
+
+def test_breaker_half_open_probe_under_concurrent_load():
+    """Half-open probing with callers hammering the engine: the trip, the
+    open window, the probe, and the close all happen while 6 threads score
+    concurrently — and NO caller ever sees an error (degraded FE-only
+    answers during the outage, full fidelity after recovery)."""
+    import threading
+
+    eng = _serve_engine(breaker_threshold=2, breaker_cooldown_s=0.2)
+    try:
+        feats = {
+            "shardA": rng.normal(size=D_FIX).astype(np.float32),
+            "shardB": rng.normal(size=D_RE).astype(np.float32),
+        }
+        full = np.float32(eng.score(feats, {"userId": "user3"}))
+        fe_only = np.float32(eng.score(feats, {"userId": "no-such-user"}))
+        assert full != fe_only
+
+        faults.configure(FaultPlan(rules=(
+            FaultRule("serve.store_resolve", kind="transient", p=1.0,
+                      max_count=4),
+        )))
+        stop = time.monotonic() + 1.2
+        errors, scores = [], []
+        lock = threading.Lock()
+
+        def hammer():
+            while time.monotonic() < stop:
+                try:
+                    s = np.float32(eng.score(feats, {"userId": "user3"}))
+                except Exception as exc:  # noqa: BLE001 — must not happen
+                    with lock:
+                        errors.append(repr(exc))
+                    return
+                with lock:
+                    scores.append(s)
+
+        threads = [threading.Thread(target=hammer) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        seen = set(scores)
+        # Every answer is one of the two legitimate fidelities — never
+        # garbage, never an exception.
+        assert seen <= {full, fe_only} and fe_only in seen
+        st = eng.stats()
+        assert st["breaker_trips"].get("userId", 0) >= 1
+        # Fault budget exhausted → a half-open probe closed the breaker
+        # while load was still running: full fidelity again at the end.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if np.float32(
+                eng.score(feats, {"userId": "user3"})
+            ) == full and not eng.stats()["degraded"]:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"breaker never recovered: {eng.stats()}")
+    finally:
+        eng.close()

@@ -550,3 +550,368 @@ def test_pad_game_batch_identity_and_inertness():
     np.testing.assert_array_equal(
         np.asarray(tr.transform(padded))[:n], np.asarray(tr.transform(b))
     )
+
+
+# ---------------------------------------------------------------------------
+# Tenant admission: token buckets, priority classes, preemption (PR 7)
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_exhaustion_and_recovery():
+    from photon_tpu.serve import TokenBucket
+
+    clk = [0.0]
+    b = TokenBucket(rate=5.0, clock=lambda: clk[0])
+    assert all(b.try_acquire() for _ in range(5))  # burst = max(rate, 1)
+    assert not b.try_acquire()  # exhausted
+    clk[0] += 0.5  # refill is continuous, not epoch-based
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()
+    clk[0] += 100.0  # refill saturates at burst, never beyond
+    assert sum(b.try_acquire() for _ in range(10)) == 5
+
+
+def test_admission_quota_shed_and_recovery():
+    from photon_tpu.serve import AdmissionConfig, AdmissionController
+    from photon_tpu.serve.admission import QuotaExceededError
+
+    clk = [0.0]
+    ctl = AdmissionController(
+        AdmissionConfig(tenant_qps={"t": 2.0}), clock=lambda: clk[0]
+    )
+    ctl.admit("t", "interactive", 0, 100)
+    ctl.admit("t", "interactive", 0, 100)
+    with pytest.raises(QuotaExceededError) as err:
+        ctl.admit("t", "interactive", 0, 100)
+    assert err.value.tenant == "t" and err.value.reason == "quota"
+    # Quota errors ARE backpressure (same 429 path), with a finer kind.
+    assert isinstance(err.value, BackpressureError)
+    clk[0] += 1.0  # bucket refills → tenant recovers without restart
+    ctl.admit("t", "interactive", 0, 100)
+    snap = ctl.snapshot()["t"]
+    assert snap["admitted"] == 3 and snap["shed"] == 1
+    # Unlisted tenants are quota-exempt (no default_qps configured).
+    for _ in range(50):
+        ctl.admit("other", "interactive", 0, 100)
+    assert ctl.snapshot()["other"]["shed"] == 0
+
+
+def test_admission_batch_class_shed_above_queue_fraction():
+    from photon_tpu.serve import AdmissionConfig, AdmissionController
+    from photon_tpu.serve.admission import QuotaExceededError
+
+    ctl = AdmissionController(AdmissionConfig(batch_queue_fraction=0.5))
+    ctl.admit("t", "batch", 49, 100)  # below the fraction: admitted
+    with pytest.raises(QuotaExceededError) as err:
+        ctl.admit("t", "batch", 50, 100)  # at/above: batch sheds first
+    assert err.value.reason == "batch_capacity"
+    ctl.admit("t", "interactive", 99, 100)  # interactive unaffected
+
+
+def test_batcher_interactive_preempts_queued_batch_at_cap():
+    release = threading.Event()
+
+    def slow(reqs):
+        release.wait(5)
+        return [r.offset for r in reqs]
+
+    mb = MicroBatcher(slow, max_batch_size=1, max_delay_s=0.0, queue_cap=2)
+    blocker = mb.submit(ScoreRequest({}, offset=0.0))  # occupies the flusher
+    time.sleep(0.05)
+    victims = [
+        mb.submit(ScoreRequest({}, offset=1.0), priority="batch"),
+        mb.submit(ScoreRequest({}, offset=2.0), priority="batch"),
+    ]
+    # Queue is at cap with batch-class work: an interactive submit evicts
+    # the NEWEST queued batch request instead of shedding itself.
+    vip = mb.submit(ScoreRequest({}, offset=3.0))
+    with pytest.raises(BackpressureError, match="preempted"):
+        victims[1].result(timeout=5)
+    # ...but a batch-class submit at cap still sheds itself.
+    with pytest.raises(BackpressureError):
+        mb.submit(ScoreRequest({}, offset=4.0), priority="batch")
+    release.set()
+    assert blocker.result(timeout=10) == 0.0
+    assert victims[0].result(timeout=10) == 1.0
+    assert vip.result(timeout=10) == 3.0
+    mb.close()
+
+
+def _admitted_engine(**quota):
+    from photon_tpu.serve import AdmissionConfig
+
+    model = make_model()
+    eng = ServingEngine(
+        model,
+        entity_indexes={"userId": make_entity_index()},
+        config=ServeConfig(
+            max_batch_size=8, max_delay_ms=1.0, hot_bytes=1,
+            admission=AdmissionConfig(**quota),
+        ),
+    )
+    return eng, model
+
+
+def test_engine_quota_429_recovery_and_tenant_stats():
+    from photon_tpu.serve.admission import QuotaExceededError
+
+    eng, model = _admitted_engine(tenant_qps={"t1": 2.0})
+    xa = rng.normal(size=D_FIX).astype(np.float32)
+    xb = rng.normal(size=D_RE).astype(np.float32)
+    req = {"features": {"shardA": xa.tolist(), "shardB": xb.tolist()},
+           "entityIds": {"userId": "user3"}}
+    from photon_tpu.serve.frontend import request_from_json
+
+    ok = [eng.submit(request_from_json(req), tenant="t1") for _ in range(2)]
+    with pytest.raises(QuotaExceededError):
+        eng.submit(request_from_json(req), tenant="t1")
+    expected = batch_scores(model, xa[None], xb[None], [3])[0]
+    for f in ok:
+        assert np.float32(f.result(timeout=30)) == expected
+    time.sleep(0.6)  # 2 qps → >1 token back: the tenant recovers
+    assert np.float32(
+        eng.submit(request_from_json(req), tenant="t1").result(timeout=30)
+    ) == expected
+    t = eng.stats()["tenants"]["t1"]
+    assert t["admitted"] == 3 and t["shed"] == 1 and t["qps_limit"] == 2.0
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Per-line error mapping in /v1/score-batch (PR 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_http_score_batch_maps_per_line_errors(http_server):
+    port, model = http_server
+    xa = rng.normal(size=(2, D_FIX)).astype(np.float32)
+    xb = rng.normal(size=(2, D_RE)).astype(np.float32)
+    good = [json.dumps({
+        "features": {"shardA": xa[i].tolist(), "shardB": xb[i].tolist()},
+        "entityIds": {"userId": i},
+    }) for i in range(2)]
+    body = "\n".join([good[0], "{not json", '{"no": "features"}', good[1]])
+    raw = _post(port, "/v1/score-batch", body.encode()).decode()
+    lines = [json.loads(s) for s in raw.splitlines()]
+    assert len(lines) == 4  # one result per input line, in order
+    expected = batch_scores(model, xa, xb, [0, 1])
+    assert np.float32(lines[0]["score"]) == expected[0]
+    assert np.float32(lines[3]["score"]) == expected[1]
+    # Malformed lines are per-line 400s, NOT backpressure and NOT fatal.
+    for bad in (lines[1], lines[2]):
+        assert bad["code"] == 400 and bad["kind"] == "bad_request"
+
+
+def test_http_tenant_quota_is_429_with_kind(http_server_quota):
+    port, _ = http_server_quota
+    body = json.dumps({
+        "features": {
+            "shardA": [0.0] * D_FIX, "shardB": [0.0] * D_RE
+        },
+        "entityIds": {"userId": "user1"},
+    }).encode()
+
+    def post(tenant):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/score", data=body, method="POST",
+            headers={"X-Tenant": tenant},
+        )
+        return urllib.request.urlopen(req, timeout=10)
+
+    post("t1").read()
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post("t1")
+    assert err.value.code == 429
+    payload = json.loads(err.value.read())
+    assert payload["kind"] == "quota" and payload["tenant"] == "t1"
+    post("t2").read()  # other tenants unaffected by t1's quota
+
+
+@pytest.fixture()
+def http_server_quota():
+    from http.server import ThreadingHTTPServer
+
+    from photon_tpu.cli.game_serving import make_handler
+
+    eng, model = _admitted_engine(tenant_qps={"t1": 1.0})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(eng, None))
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server.server_address[1], model
+    server.shutdown()
+    server.server_close()
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Multi-process front end: IPC channel, LATEST-pointer reload, e2e (PR 7)
+# ---------------------------------------------------------------------------
+
+
+def test_scorer_ipc_parity_stats_and_error_mapping(tmp_path):
+    from photon_tpu.serve.frontend import (
+        RemoteBackend,
+        ScorerClient,
+        ScorerServer,
+        classify_exception,
+        request_from_json,
+    )
+
+    eng, model = make_engine(max_batch_size=4)
+    srv = ScorerServer(eng, str(tmp_path / "scorer.sock"))
+    srv.start()
+    cli = ScorerClient(str(tmp_path / "scorer.sock"))
+    try:
+        n = 6
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        futs = [cli.submit_score({
+            "features": {"shardA": xa[i].tolist(), "shardB": xb[i].tolist()},
+            "entityIds": {"userId": i},
+        }, None, "interactive") for i in range(n)]
+        got = np.asarray(
+            [np.float32(f.result(timeout=30)["score"]) for f in futs]
+        )
+        # Same engine, same jitted program: the IPC hop changes nothing.
+        np.testing.assert_array_equal(
+            got, batch_scores(model, xa, xb, list(range(n)))
+        )
+        # Errors cross the socket as (code, kind) and rebuild client-side.
+        with pytest.raises(ValueError):
+            cli.submit_score({"no": "features"}, None, "interactive").result(
+                timeout=30
+            )
+        try:
+            cli.submit_score({"no": "features"}, None, "interactive").result(
+                timeout=30
+            )
+        except ValueError as exc:
+            assert classify_exception(exc) == (400, "bad_request")
+        stats = RemoteBackend(cli, worker_index=3).stats()
+        assert stats["worker"] == 3 and stats["retraces_since_warmup"] == 0
+    finally:
+        cli.close()
+        srv.close()
+        eng.close()
+
+
+def _publish_generation(root, gen, scale):
+    """Training-side publication: save a generation + flip the fsync'd
+    LATEST pointer (what train_glm/game_training do on final checkpoint)."""
+    import os
+
+    from photon_tpu.io.model_io import publish_latest_pointer, save_game_model
+
+    model = make_model(scale)
+    imaps = {
+        "shardA": IndexMap.build([f"a{j}" for j in range(D_FIX)]),
+        "shardB": IndexMap.build([f"b{j}" for j in range(D_RE)]),
+    }
+    eidx = make_entity_index()
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    # sparsity_threshold=0: keep all nonzero coefficients → exact round trip.
+    save_game_model(model, os.path.join(root, gen), imaps, {"userId": eidx},
+                    sparsity_threshold=0.0)
+    publish_latest_pointer(root, gen)
+    return model
+
+
+def test_latest_pointer_resolution_and_reload_watcher(tmp_path):
+    from photon_tpu.cli.game_serving import _reload_watcher, resolve_model_dir
+    from photon_tpu.serve.engine import load_engine
+
+    root = str(tmp_path)
+    m1 = _publish_generation(root, "gen-1", 1.0)
+    assert resolve_model_dir(root).endswith("gen-1")
+    eng = load_engine(
+        resolve_model_dir(root), artifacts_dir=root,
+        config=ServeConfig(max_batch_size=4, hot_bytes=1),
+    )
+    stop = threading.Event()
+    t = threading.Thread(
+        target=_reload_watcher, args=(eng, root, 0.05, stop), daemon=True
+    )
+    t.start()
+    try:
+        xa = rng.normal(size=D_FIX).astype(np.float32)
+        xb = rng.normal(size=D_RE).astype(np.float32)
+        feats = {"shardA": xa, "shardB": xb}
+        ids = {"userId": "user7"}
+        assert np.float32(eng.score(feats, ids)) == batch_scores(
+            m1, xa[None], xb[None], [7]
+        )[0]
+        m2 = _publish_generation(root, "gen-2", 3.0)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if eng.model_version.endswith("gen-2"):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"watcher never swapped: {eng.model_version}")
+        # The swapped-in generation scores EXACTLY like its source model:
+        # publish → LATEST → watcher → reload is lossless end to end.
+        assert np.float32(eng.score(feats, ids)) == batch_scores(
+            m2, xa[None], xb[None], [7]
+        )[0]
+        assert eng.retraces_since_warmup == 0  # reload never retraces
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        eng.close()
+
+
+def test_multiprocess_front_end_end_to_end(tmp_path):
+    """Forked-worker deployment shape, as a real subprocess (forking with
+    jax initialized in THIS process is unsafe): banner → parity → healthz
+    → SIGTERM drain exits 0."""
+    import signal
+    import subprocess
+    import sys
+
+    root = str(tmp_path)
+    model = _publish_generation(root, "gen-1", 1.0)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_tpu.cli.game_serving",
+         "--model-input-dir", root, "--port", "0", "--workers", "1",
+         "--max-batch-size", "4", "--queue-cap", "64"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        banner = {}
+
+        def _read():
+            banner["line"] = proc.stdout.readline()
+
+        rt = threading.Thread(target=_read, daemon=True)
+        rt.start()
+        rt.join(timeout=300)
+        assert banner.get("line"), "no startup banner within 300s"
+        up = json.loads(banner["line"])
+        assert up["workers"] == 1
+        port = up["port"]
+        n = 4
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        got = np.asarray([np.float32(json.loads(_post(port, "/v1/score", json.dumps({
+            "features": {"shardA": xa[i].tolist(), "shardB": xb[i].tolist()},
+            "entityIds": {"userId": i},
+        }).encode()))["score"]) for i in range(n)])
+        # Worker process → unix socket → scorer process scores EXACTLY what
+        # the in-process batch path scores from the same published model.
+        np.testing.assert_array_equal(
+            got, batch_scores(model, xa, xb, list(range(n)))
+        )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["retraces_since_warmup"] == 0
+        assert "worker" in health and health["model_version"].endswith("gen-1")
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0  # graceful drain, clean exit
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

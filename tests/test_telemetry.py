@@ -457,3 +457,52 @@ def _minimal_args(mod):
         "--coordinate-configurations", "name=global,feature.shard=s",
         "--update-sequence", "global",
     ]
+
+
+def test_run_report_byte_budget_rotates_and_drops_oldest(tmp_path):
+    """The serving sink is long-lived: the report must respect a byte
+    budget by (a) rotating the previous file to ``.1`` and (b) dropping the
+    OLDEST span records first — never meta/env/metric — while counting
+    what it shed."""
+    from photon_tpu.obs.report import write_run_report
+
+    path = tmp_path / "run.jsonl"
+    meta = {"record": "meta", "driver": "t", "run_id": "r",
+            "schema_version": 1}
+    spans = [{"record": "span", "name": f"s{i:04d}", "parent": None,
+              "start_s": float(i), "duration_s": 0.1, "thread": "t"}
+             for i in range(200)]
+    write_run_report(str(path), [meta] + spans)
+    full_size = path.stat().st_size
+    def dropped():
+        inst = registry().find("telemetry_records_dropped_total")
+        return inst.value if inst is not None else 0
+
+    before = dropped()
+
+    write_run_report(str(path), [meta] + spans, max_bytes=full_size // 4)
+    assert path.stat().st_size <= full_size // 4
+    # Previous generation rotated aside, not clobbered.
+    assert (tmp_path / "run.jsonl.1").stat().st_size == full_size
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    kinds = [r["record"] for r in lines]
+    assert "meta" in kinds  # identity records never drop
+    kept = [r["name"] for r in lines if r["record"] == "span"]
+    # Oldest-first shedding: the tail of the run survives.
+    assert kept and kept == [f"s{i:04d}" for i in
+                             range(200 - len(kept), 200)]
+    assert dropped() - before == 200 - len(kept)
+
+
+def test_tracer_span_ring_bounds_memory():
+    from photon_tpu.obs.trace import Tracer
+
+    tr = Tracer(max_spans=10)
+    for i in range(25):
+        with tr.span(f"s{i}"):
+            pass
+    spans = tr.spans()
+    assert len(spans) == 10 and tr.dropped_spans == 15
+    assert spans[-1].name == "s24"  # ring keeps the NEWEST spans
+    tr.reset()
+    assert tr.spans() == [] and tr.dropped_spans == 0
