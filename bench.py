@@ -1295,6 +1295,302 @@ def run_fault_soak(n_requests: int = 3000, d: int = 32, E: int = 512):
     }
 
 
+def run_rollout_soak(E: int = 16, n_train: int = 512):
+    """Continuous-rollout soak: the full generation lifecycle in-process.
+
+    Trains gen-1, serves it, then — with producer threads scoring the
+    whole time — walks the rollout state machine end to end:
+
+      1. incremental retrain → gen-2 published → watcher shadows it on
+         live traffic, meets the shadow quota, promotes;
+      2. a generation trained under ``model.corrupt_manifest`` is REFUSED
+         by the validation gate (LATEST and the serving primary hold);
+      3. a good gen-3 promotes, then ``serve.store_resolve`` faults trip
+         the circuit breaker and the watcher auto-rolls back to gen-2,
+         poisons gen-3, and refuses to re-promote it.
+
+    Acceptance (ISSUE 8): ZERO caller-visible errors across every phase,
+    ZERO retraces after warm-up, the poisoned generation never serves
+    again, and post-rollback scores are bit-identical to a direct pinned
+    scoring of the rolled-back-to generation.
+    """
+    import os
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+
+    from photon_tpu.cli.game_serving import RolloutOptions, _reload_watcher
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        is_poisoned,
+        load_game_model,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.serve import ScoreRequest, ServeConfig, ServingEngine
+    from photon_tpu.train.incremental import (
+        compute_holdout_metrics,
+        incremental_update,
+    )
+    from photon_tpu.types import TaskType
+    from photon_tpu.utils import faults
+
+    d_fix, d_re = 5, 3
+    rng = np.random.default_rng(61)
+    w_fix = rng.normal(size=d_fix).astype(np.float32)
+    w_re = rng.normal(scale=1.5, size=(E, d_re)).astype(np.float32)
+
+    def make_batch(n, entities, seed):
+        r = np.random.default_rng(seed)
+        Xf = r.normal(size=(n, d_fix)).astype(np.float32)
+        Xf[:, 0] = 1.0
+        Xr = r.normal(size=(n, d_re)).astype(np.float32)
+        Xr[:, 0] = 1.0
+        users = r.choice(np.asarray(entities, np.int32), size=n)
+        logits = Xf @ w_fix + np.sum(Xr * w_re[users], axis=1)
+        y = (r.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+        return GameBatch(
+            label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+            weight=jnp.ones(n, jnp.float32),
+            features={"global": jnp.asarray(Xf), "per_user": jnp.asarray(Xr)},
+            entity_ids={"userId": jnp.asarray(users)},
+        )
+
+    root = tempfile.mkdtemp(prefix="rollout-soak-")
+    imaps = {
+        "global": IndexMap.build([f"g{j}" for j in range(d_fix)]),
+        "per_user": IndexMap.build([f"r{j}" for j in range(d_re)]),
+    }
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"user{e}")
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    coord_configs = [
+        FixedEffectCoordinateConfig("global", "global"),
+        RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+    ]
+    suite = EvaluationSuite([EvaluatorSpec.parse("AUC")],
+                            num_entities={"userId": E})
+    valid = make_batch(256, list(range(E)), seed=2)
+
+    def counters(prefixes=("serve_", "model_")):
+        return {
+            f"{m['metric']}{m.get('labels') or ''}": m["value"]
+            for m in registry().snapshot()
+            if m["type"] == "counter" and m["metric"].startswith(prefixes)
+        }
+
+    before = counters()
+    _progress("rollout soak: training gen-1")
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=coord_configs,
+        num_iterations=2, num_entities={"userId": E},
+    )
+    (res,) = est.fit(make_batch(n_train, list(range(E)), seed=1),
+                     validation_batch=valid, evaluation_suite=suite)
+    g1 = os.path.join(root, "gen-1")
+    save_game_model(res.model, g1, imaps, {"userId": eidx},
+                    sparsity_threshold=0.0)
+    write_generation_manifest(
+        g1, parent=None,
+        holdout_metrics=compute_holdout_metrics(res.model, valid, suite))
+    assert gate_and_publish(root, "gen-1").ok
+
+    engine = ServingEngine(
+        load_game_model(g1, imaps, {"userId": eidx}, to_device=False),
+        entity_indexes={"userId": eidx}, index_maps=imaps,
+        config=ServeConfig(max_batch_size=8, max_delay_ms=1.0,
+                           hot_bytes=1 << 30, max_versions=3,
+                           shadow_fraction=1.0, breaker_threshold=2,
+                           breaker_cooldown_s=0.2),
+        model_version=g1,
+    )
+    opts = RolloutOptions(shadow_fraction=1.0, shadow_quota=16,
+                          divergence_bound=1e6, breaker_trip_bound=1,
+                          max_reload_attempts=3, backoff_s=0.05)
+    stop = threading.Event()
+    watcher = threading.Thread(target=_reload_watcher,
+                               args=(engine, root, 0.05, stop, opts),
+                               daemon=True)
+    watcher.start()
+
+    # Live traffic for the whole soak; every phase transition below happens
+    # under this load, and any exception that escapes submit() is a failure.
+    Xf = rng.normal(size=(64, d_fix)).astype(np.float32)
+    Xf[:, 0] = 1.0
+    Xr = rng.normal(size=(64, d_re)).astype(np.float32)
+    Xr[:, 0] = 1.0
+    ok = errors = 0
+    lock = threading.Lock()
+    done = threading.Event()
+
+    def producer(seed):
+        nonlocal ok, errors
+        r = np.random.default_rng(seed)
+        while not done.is_set():
+            i = int(r.integers(0, 64))
+            u = int(r.integers(0, E))
+            try:
+                engine.submit(ScoreRequest(
+                    {"global": Xf[i], "per_user": Xr[i]},
+                    {"userId": f"user{u}"},
+                    uid=f"{i}:{u}",
+                )).result(timeout=120)
+                with lock:
+                    ok += 1
+            except Exception:  # noqa: BLE001 — any escape is a soak failure
+                with lock:
+                    errors += 1
+            time.sleep(0.002)
+
+    producers = [threading.Thread(target=producer, args=(seed,), daemon=True)
+                 for seed in (101, 102)]
+    t0 = time.perf_counter()
+    for t in producers:
+        t.start()
+
+    def wait_for(pred, timeout, msg):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"rollout soak: timed out waiting for {msg}")
+
+    def latest():
+        with open(os.path.join(root, "LATEST")) as f:
+            return f.read().strip()
+
+    # Phase 1: incremental retrain → shadow on live traffic → promote.
+    _progress("rollout soak: gen-2 incremental → shadow → promote")
+    r2 = incremental_update(
+        root, make_batch(n_train, list(range(E)), seed=3), imaps,
+        {"userId": eidx}, TaskType.LOGISTIC_REGRESSION, coord_configs,
+        ["global", "per_user"], valid_batch=valid, evaluation_suite=suite,
+        num_iterations=1, metric_tolerance=0.2)
+    assert r2.published, r2.gate_reason
+    wait_for(lambda: engine.model_version.endswith("gen-2"), 60,
+             "gen-2 shadow quota + promotion")
+    # Shadow scores recorded during the quota phase must be bit-exact with
+    # a direct pinned-version score of the same request (uid encodes the
+    # feature row + user, so the request is reproducible).
+    samples = engine.shadow_samples()
+    assert len(samples) >= opts.shadow_quota, len(samples)
+    for s in samples:
+        i, u = (int(v) for v in s["uid"].split(":"))
+        direct = np.float32(engine.score(
+            {"global": Xf[i], "per_user": Xr[i]}, {"userId": f"user{u}"},
+            model_version="gen-2",
+        ))
+        assert np.float32(s["shadow"]) == direct, (s, direct)
+
+    # Phase 2: a corrupted generation must be refused while serving holds.
+    _progress("rollout soak: corrupt generation refused by the gate")
+    faults.configure(faults.FaultPlan(rules=(
+        faults.FaultRule("model.corrupt_manifest", kind="permanent", at=(0,)),
+    )))
+    try:
+        r3 = incremental_update(
+            root, make_batch(n_train, list(range(E)), seed=4), imaps,
+            {"userId": eidx}, TaskType.LOGISTIC_REGRESSION, coord_configs,
+            ["global", "per_user"], valid_batch=valid,
+            evaluation_suite=suite, num_iterations=1, metric_tolerance=0.2)
+    finally:
+        faults.reset()
+    assert not r3.published and "checksum_mismatch" in r3.gate_reason
+    assert latest() == "gen-2"
+    time.sleep(0.3)  # a few watcher polls: the refused gen must never load
+    assert engine.model_version.endswith("gen-2")
+
+    # Phase 3: good gen-4 promotes, then breaker trips roll it back.
+    _progress("rollout soak: gen-4 promote, breaker-trip auto-rollback")
+    r4 = incremental_update(
+        root, make_batch(n_train, list(range(E)), seed=5), imaps,
+        {"userId": eidx}, TaskType.LOGISTIC_REGRESSION, coord_configs,
+        ["global", "per_user"], valid_batch=valid, evaluation_suite=suite,
+        num_iterations=1, metric_tolerance=0.2)
+    assert r4.published, r4.gate_reason
+    gen4 = r4.generation
+    wait_for(lambda: engine.model_version.endswith(gen4), 60,
+             f"{gen4} promotion")
+    faults.configure(faults.FaultPlan(seed=7, rules=(
+        faults.FaultRule("serve.store_resolve", kind="transient", p=1.0,
+                         max_count=24),
+    )))
+    # The poison record lands after the in-engine demotion, so awaiting it
+    # implies the rollback completed.
+    wait_for(lambda: is_poisoned(root, gen4), 60, f"{gen4} auto-rollback")
+    faults.reset()
+    wait_for(lambda: latest() == "gen-2", 30, "LATEST repointed to parent")
+    time.sleep(0.5)  # poisoned: the watcher must not re-promote it
+    assert engine.model_version.endswith("gen-2")
+
+    done.set()
+    for t in producers:
+        t.join(timeout=10)
+    wall = time.perf_counter() - t0
+
+    # Half-open probes close any breaker the injected faults tripped, then
+    # the parity bar: live scores == direct pinned scoring of gen-2.
+    probe = [engine.submit(ScoreRequest(
+        {"global": Xf[i], "per_user": Xr[i]}, {"userId": f"user{i % E}"},
+    )).result(timeout=120) for i in range(16)]
+    assert all(np.isfinite(s) for s in probe)
+    time.sleep(0.3)
+    got = [np.float32(engine.score(
+        {"global": Xf[i], "per_user": Xr[i]}, {"userId": f"user{i % E}"},
+    )) for i in range(16)]
+    pinned = [np.float32(engine.score(
+        {"global": Xf[i], "per_user": Xr[i]}, {"userId": f"user{i % E}"},
+        model_version=engine.model_version,
+    )) for i in range(16)]
+    assert got == pinned, "post-rollback scores != pinned gen-2 scores"
+
+    retraces = engine.retraces_since_warmup
+    stats = engine.stats()
+    stop.set()
+    watcher.join(timeout=10)
+    engine.close()
+
+    delta = {k: v - before.get(k, 0) for k, v in counters().items()
+             if v != before.get(k, 0)}
+    trips = sum(v for k, v in delta.items()
+                if k.startswith("serve_breaker_trips_total"))
+    gate_failures = sum(v for k, v in delta.items()
+                        if k.startswith("model_gate_failures_total"))
+    assert errors == 0, f"{errors} caller-visible errors during rollout soak"
+    assert retraces == 0, f"{retraces} retraces after warm-up"
+    assert trips >= 1, f"store faults must trip the breaker: {delta}"
+    assert gate_failures >= 1, f"gate must refuse the corrupt gen: {delta}"
+    return {
+        "metric": "rollout_soak",
+        "unit": "requests",
+        "value": ok,
+        "wall_s": round(wall, 3),
+        "ok": ok,
+        "caller_errors": errors,
+        "retraces": retraces,
+        "breaker_trips": trips,
+        "gate_failures": gate_failures,
+        "refused_generation": r3.generation,
+        "rolled_back_generation": gen4,
+        "final_primary": os.path.basename(stats["primary"])
+        if isinstance(stats.get("primary"), str) else stats.get("primary"),
+    }
+
+
 def run_serve_soak(
     duration_s: float = 20.0,
     workers: int = 2,
@@ -2037,6 +2333,12 @@ def main():
         # Serving soak under injected store faults + reload churn: zero
         # caller-visible crashes, breaker trips + recovers; CPU-measurable.
         print(json.dumps(run_fault_soak()))
+        return
+    if "--rollout-soak" in sys.argv:
+        # Full continuous-rollout lifecycle under live traffic: train →
+        # publish → shadow → promote → refuse a corrupt generation →
+        # breaker-trip auto-rollback; zero caller errors, zero retraces.
+        print(json.dumps(run_rollout_soak()))
         return
     if "--serve-soak" in sys.argv:
         # Multi-process front end under sustained mixed-tenant load with

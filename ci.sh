@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|serve|faults|soak|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|serve|faults|soak|rollout|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -412,6 +412,20 @@ run_soak() {
     echo "   serve-soak smoke OK"
 }
 
+run_rollout() {
+    # Continuous-rollout smoke: the full generation lifecycle in one
+    # process — train gen-1, serve it, incremental-retrain gen-2, shadow
+    # it on live traffic and promote, REFUSE a checksum-corrupted
+    # generation at the validation gate, then trip the circuit breaker on
+    # a promoted generation and auto-roll back to its parent (poisoned,
+    # never re-promoted). run_rollout_soak asserts the ISSUE 8 bar
+    # itself: zero caller-visible errors, zero retraces after warm-up,
+    # and post-rollback bit parity with direct pinned scoring.
+    echo "== rollout: train -> shadow -> promote -> gate-refuse -> rollback =="
+    JAX_PLATFORMS=cpu python bench.py --rollout-soak
+    echo "   rollout-soak smoke OK"
+}
+
 run_install() {
     echo "== packaging: editable install + console entry points =="
     tmp="$(mktemp -d)"
@@ -426,7 +440,8 @@ run_install() {
     # Entry points must resolve and print usage without touching a backend.
     for cmd in photon-tpu-game-training photon-tpu-game-scoring \
                photon-tpu-train-glm photon-tpu-feature-indexing \
-               photon-tpu-name-and-term-bags photon-tpu-game-serving; do
+               photon-tpu-name-and-term-bags photon-tpu-game-serving \
+               photon-tpu-game-incremental; do
         PYTHONPATH="$parent_site" "$tmp/venv/bin/$cmd" --help > /dev/null
         echo "   $cmd --help OK"
     done
@@ -443,8 +458,9 @@ case "$stage" in
     serve) run_serve ;;
     faults) run_faults ;;
     soak) run_soak ;;
+    rollout) run_rollout ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_serve; run_faults; run_soak; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_serve; run_faults; run_soak; run_rollout; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

@@ -156,8 +156,13 @@ class CoordinateDescent:
         wall_times: Dict[str, List[float]] = {cid: [] for cid in self.update_sequence}
         metric_history: List[Dict[str, float]] = []
         best_metric: Optional[float] = None
-        best_model = GameModel(dict(models)) if all(
-            m is not None for m in models.values()
+        # Seed the best-model slot from the warm start only when a validation
+        # pass will actually run and can replace it; without validation the
+        # seed would survive to the end and the caller would get the initial
+        # model back with every trained pass discarded.
+        has_validation = validation_fn is not None and validation_batch is not None
+        best_model = GameModel(dict(models)) if (
+            has_validation and all(m is not None for m in models.values())
         ) else None
 
         start_it = 0

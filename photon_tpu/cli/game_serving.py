@@ -43,12 +43,14 @@ Shutdown (SIGTERM/SIGINT) drains workers first, then the queue, and with
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import logging
 import os
 import signal
 import threading
 from http.server import ThreadingHTTPServer
+from typing import Optional
 
 from photon_tpu.cli.common import setup_logging
 from photon_tpu.serve.admission import AdmissionConfig, parse_tenant_rates
@@ -135,6 +137,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "or a rewritten model-metadata.json); a change "
                         "triggers a zero-downtime reload. 0 disables — "
                         "reloads then happen only via POST /v1/reload")
+    p.add_argument("--shadow-fraction", type=float, default=0.0,
+                   help="fraction of live primary traffic re-scored on a "
+                        "newly detected generation BEFORE it can become "
+                        "primary (divergence recorded, responses untouched). "
+                        "0 = no shadow phase: new generations promote "
+                        "directly, the pre-rollout behavior")
+    p.add_argument("--shadow-quota", type=int, default=64,
+                   help="shadow-scored requests a candidate must pass "
+                        "(divergence under --divergence-bound) before the "
+                        "watcher promotes it to primary")
+    p.add_argument("--divergence-bound", type=float, default=1e-3,
+                   help="max |shadow - primary| score divergence; a "
+                        "candidate breaching it is abandoned and poisoned")
+    p.add_argument("--breaker-trip-bound", type=int, default=0,
+                   help="circuit-breaker trips since promotion that trigger "
+                        "automatic rollback to the parent generation "
+                        "(0 disables rollback monitoring)")
+    p.add_argument("--reload-max-attempts", type=int, default=3,
+                   help="reload attempts (with exponential backoff) per "
+                        "detected generation before it is marked poisoned "
+                        "and skipped for good")
+    p.add_argument("--reload-backoff", type=float, default=0.2,
+                   help="initial retry backoff seconds for a failed reload")
+    p.add_argument("--max-model-versions", type=int, default=2,
+                   help="resident model generations (primary + candidates "
+                        "pinnable via X-Model-Version)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -168,33 +196,164 @@ def _model_fingerprint(directory: str):
     return (directory, mtime)
 
 
-def _reload_watcher(engine, model_dir: str, interval: float,
-                    stop: threading.Event) -> None:
-    """Poll ``model_dir`` for a new generation and hot-swap it in. A failed
-    reload keeps the current model serving (engine guarantee) and is NOT
-    retried until the fingerprint changes again — one attempt per published
-    generation, no hot-loop on a broken publish."""
-    from photon_tpu.io.model_io import load_game_model
+@dataclasses.dataclass
+class RolloutOptions:
+    """Watcher-side rollout policy. The defaults reproduce the pre-rollout
+    watcher: no shadow phase (direct promote on detection), no rollback
+    monitoring — plus retry-with-backoff on a failed reload (a transient
+    store fault used to permanently skip a good generation)."""
 
-    current = _model_fingerprint(resolve_model_dir(model_dir))
-    while not stop.wait(interval):
-        target = resolve_model_dir(model_dir)
-        fp = _model_fingerprint(target)
-        if fp == current:
-            continue
+    shadow_fraction: float = 0.0
+    shadow_quota: int = 64
+    divergence_bound: float = 1e-3
+    breaker_trip_bound: int = 0  # 0 = rollback monitoring off
+    max_reload_attempts: int = 3
+    backoff_s: float = 0.2
+    backoff_max_s: float = 5.0
+
+
+def _poison(publish_root: str, version: str, reason: str) -> None:
+    from photon_tpu.io.model_io import mark_poisoned
+    from photon_tpu.obs.metrics import registry
+
+    try:
+        mark_poisoned(publish_root, version, reason)
+    except OSError:
+        logger.exception("could not record poisoned generation %r", version)
+    registry().counter("serve_generations_poisoned_total").inc()
+
+
+def _install_generation(engine, target: str, opts: RolloutOptions,
+                        stop: threading.Event, publish_root: str) -> str:
+    """Load one detected generation with retry+backoff. Returns 'shadow'
+    (resident, mirroring traffic), 'promoted' (direct reload), 'poisoned'
+    (attempts exhausted — never tried again), or 'stopped'."""
+    from photon_tpu.io.model_io import load_game_model
+    from photon_tpu.obs.metrics import registry
+
+    delay = opts.backoff_s
+    attempts = max(int(opts.max_reload_attempts), 1)
+    for attempt in range(1, attempts + 1):
         try:
-            logger.info("model change detected: reloading from %s", target)
             model = load_game_model(
                 target, engine._index_maps, engine._entity_indexes,
                 to_device=False,
             )
+            if opts.shadow_fraction > 0 and opts.shadow_quota > 0:
+                engine.load_version(model, model_version=target)
+                engine.start_shadow(target, opts.shadow_fraction)
+                return "shadow"
             engine.reload(model, model_version=target)
+            return "promoted"
         except Exception as exc:  # noqa: BLE001 — old model keeps serving
             logger.warning(
-                "auto-reload from %s failed (%s); model %r keeps serving",
-                target, exc, engine.model_version,
+                "auto-reload from %s failed (attempt %d/%d): %s; model %r "
+                "keeps serving",
+                target, attempt, attempts, exc, engine.model_version,
             )
+            registry().counter("serve_reload_retries_total").inc()
+            if attempt >= attempts:
+                _poison(
+                    publish_root,
+                    os.path.basename(target.rstrip("/")),
+                    f"reload_failed: {exc}",
+                )
+                return "poisoned"
+            if stop.wait(min(delay, opts.backoff_max_s)):
+                return "stopped"
+            delay = min(delay * 2.0, opts.backoff_max_s)
+    return "stopped"
+
+
+def _repoint_latest(publish_root: str, version: str) -> None:
+    """After a rollback, move the on-disk LATEST pointer back to the parent
+    so a restart (or any other consumer of the pointer) doesn't resurrect
+    the demoted generation."""
+    from photon_tpu.io.model_io import publish_latest_pointer
+
+    name = os.path.basename(str(version).rstrip("/"))
+    if os.path.isdir(os.path.join(publish_root, name)):
+        try:
+            publish_latest_pointer(publish_root, name)
+        except OSError:
+            logger.exception("could not repoint LATEST to %r", name)
+
+
+def _reload_watcher(engine, model_dir: str, interval: float,
+                    stop: threading.Event,
+                    opts: Optional[RolloutOptions] = None) -> None:
+    """Poll ``model_dir`` for new generations and walk each through the
+    rollout lifecycle: candidate → (shadow →) primary → possibly
+    rolled-back.
+
+    - A detected generation loads with retry+backoff; exhausted attempts
+      poison it (skipped forever — a restart honors the poison list too).
+    - With ``shadow_fraction > 0`` the candidate first mirrors a sample of
+      live traffic; it promotes only after ``shadow_quota`` shadow scores
+      stayed under ``divergence_bound``, and is abandoned + poisoned on a
+      breach.
+    - With ``breaker_trip_bound > 0`` a promoted generation whose
+      breaker-trip delta crosses the bound is demoted back to its parent
+      (engine rollback), poisoned, and LATEST is repointed to the parent.
+
+    A failed reload keeps the current model serving (engine guarantee)."""
+    from photon_tpu.io.model_io import is_poisoned
+
+    opts = opts or RolloutOptions()
+    current = _model_fingerprint(resolve_model_dir(model_dir))
+    candidate: Optional[str] = None
+    while not stop.wait(interval):
+        # Shadow-phase verdicts for the current candidate, if any.
+        if candidate is not None:
+            st = engine.shadow_stats()
+            if st["version"] is None:
+                candidate = None  # cleared elsewhere (manual promote/stop)
+            elif st["max_divergence"] > opts.divergence_bound:
+                engine.stop_shadow()
+                reason = f"shadow_divergence: {st['max_divergence']:.6g}"
+                logger.warning(
+                    "candidate %r abandoned: %s", candidate, reason
+                )
+                _poison(model_dir, os.path.basename(candidate.rstrip("/")),
+                        reason)
+                candidate = None
+            elif st["count"] >= opts.shadow_quota:
+                logger.info(
+                    "candidate %r passed shadow quota (%d scores, max "
+                    "divergence %.3g); promoting",
+                    candidate, st["count"], st["max_divergence"],
+                )
+                engine.promote(candidate)
+                candidate = None
+        # Post-promotion health: breaker-trip delta since the promotion.
+        if opts.breaker_trip_bound > 0:
+            trips = engine.trips_since_promotion()
+            if trips >= opts.breaker_trip_bound:
+                demoted = engine.rollback(f"breaker_trips: {trips}")
+                if demoted is not None:
+                    _poison(model_dir,
+                            os.path.basename(str(demoted).rstrip("/")),
+                            f"breaker_trips: {trips}")
+                    _repoint_latest(model_dir, engine.model_version)
+                    current = _model_fingerprint(resolve_model_dir(model_dir))
+        # New-generation detection.
+        target = resolve_model_dir(model_dir)
+        fp = _model_fingerprint(target)
+        if fp == current:
+            continue
         current = fp
+        name = os.path.basename(target.rstrip("/"))
+        if is_poisoned(model_dir, name):
+            logger.warning(
+                "ignoring poisoned generation %r (see %s)", name, model_dir
+            )
+            continue
+        logger.info("model change detected: loading %s", target)
+        outcome = _install_generation(engine, target, opts, stop, model_dir)
+        if outcome == "shadow":
+            candidate = target
+        elif outcome == "stopped":
+            return
 
 
 def make_handler(engine, artifacts_dir=None):
@@ -220,6 +379,19 @@ def _serve_config(args) -> ServeConfig:
         hot_bytes=int(args.hot_bytes_mb * (1 << 20)),
         default_deadline_ms=args.deadline_ms,
         admission=_admission_config(args),
+        max_versions=args.max_model_versions,
+        shadow_fraction=args.shadow_fraction,
+    )
+
+
+def _rollout_options(args) -> RolloutOptions:
+    return RolloutOptions(
+        shadow_fraction=args.shadow_fraction,
+        shadow_quota=args.shadow_quota,
+        divergence_bound=args.divergence_bound,
+        breaker_trip_bound=args.breaker_trip_bound,
+        max_reload_attempts=args.reload_max_attempts,
+        backoff_s=args.reload_backoff,
     )
 
 
@@ -234,7 +406,7 @@ def _start_background(args, engine, stop: threading.Event) -> None:
         threading.Thread(
             target=_reload_watcher,
             args=(engine, args.model_input_dir, args.reload_poll_interval,
-                  stop),
+                  stop, _rollout_options(args)),
             name="model-reload-watcher",
             daemon=True,
         ).start()

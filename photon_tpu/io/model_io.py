@@ -11,8 +11,12 @@ role) and are structured for interop with reference tooling.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import json
+import logging
 import os
+import time
 from typing import Dict, Optional, Tuple
 
 import jax.numpy as jnp
@@ -37,6 +41,10 @@ RANDOM_DIR = "random-effect"
 METADATA_FILE = "model-metadata.json"
 ID_INFO_FILE = "id-info"
 COEFF_DIR = "coefficients"
+MANIFEST_FILE = "generation-manifest.json"
+POISON_FILE = "poisoned-generations.json"
+
+logger = logging.getLogger(__name__)
 
 # Fully-qualified class names: the reference loader instantiates models via
 # Class.forName(modelClass) (AvroUtils.scala:390), so models this framework
@@ -305,6 +313,349 @@ def publish_latest_pointer(publish_root: str, generation: str) -> str:
     except OSError:
         pass
     return path
+
+
+# ---------------------------------------------------------------------------
+# Generation manifests + validation gate (the safe-rollout contract).
+#
+# A *generation* is one fully written model directory under a publish root.
+# Its manifest records per-file sha256 checksums, the parent generation id,
+# and the holdout-metric record of the training run that produced it. The
+# gate (verify_generation / gate_and_publish) re-derives everything the
+# manifest claims BEFORE the LATEST pointer may move: checksums, coefficient
+# sanity (finite values, norm drift bounded vs the parent), and a holdout
+# regression bound. A failing generation stays on disk — written, inspectable,
+# never pointed to — with the refusal reason recorded in its own manifest.
+# ---------------------------------------------------------------------------
+
+
+def _file_sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def generation_checksums(model_dir: str) -> Dict[str, str]:
+    """relpath → sha256 over every payload file of a generation (the
+    manifest itself is excluded — it cannot checksum its own content)."""
+    out: Dict[str, str] = {}
+    for root, _dirs, files in os.walk(model_dir):
+        for fn in sorted(files):
+            rel = os.path.relpath(os.path.join(root, fn), model_dir)
+            if rel == MANIFEST_FILE:
+                continue
+            out[rel] = _file_sha256(os.path.join(root, fn))
+    return out
+
+
+def _write_json_durable(path: str, obj: dict) -> None:
+    """tmp + fsync + rename: the same torn-write discipline as LATEST."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_generation_manifest(
+    model_dir: str,
+    parent: Optional[str] = None,
+    holdout_metrics: Optional[Dict[str, float]] = None,
+    extra: Optional[dict] = None,
+) -> dict:
+    """Record the generation's identity: per-file checksums, parent
+    generation id, holdout metrics. Written AFTER save_game_model, BEFORE
+    the gate — the gate verifies this record against the files.
+
+    Fault site ``model.corrupt_manifest`` simulates bit-rot that still
+    parses: one recorded checksum is flipped, so the directory deserializes
+    fine everywhere but the gate's checksum pass must refuse it."""
+    from photon_tpu.utils import faults
+
+    manifest = {
+        "generation": os.path.basename(model_dir.rstrip("/")),
+        "parent": parent,
+        "createdAt": time.time(),
+        "holdoutMetrics": dict(holdout_metrics or {}),
+        "files": generation_checksums(model_dir),
+        "gate": {"status": "candidate", "reason": None},
+        **(extra or {}),
+    }
+    rule = faults.injector().fire("model.corrupt_manifest")
+    if rule is not None and manifest["files"]:
+        rel = sorted(manifest["files"])[0]
+        manifest["files"][rel] = "0" * 64
+        logger.warning(
+            "fault model.corrupt_manifest: flipped checksum of %r in %s",
+            rel, model_dir,
+        )
+    _write_json_durable(os.path.join(model_dir, MANIFEST_FILE), manifest)
+    return manifest
+
+
+def load_generation_manifest(model_dir: str) -> Optional[dict]:
+    path = os.path.join(model_dir, MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def coordinate_norms(model_dir: str) -> Dict[str, dict]:
+    """Per-coordinate coefficient summary straight off the Avro part files
+    (no index maps needed): L2 norm over all recorded means, record count,
+    and whether every value (means + variances) is finite. This is what the
+    gate's coefficient-sanity pass runs on — it must not depend on loading
+    artifacts that could themselves be the corrupted thing."""
+    import math
+
+    out: Dict[str, dict] = {}
+    meta = read_model_metadata(model_dir)
+    for cid, info in meta.get("coordinates", {}).items():
+        sub = FIXED_DIR if info.get("type") == "fixed" else RANDOM_DIR
+        cdir = os.path.join(model_dir, sub, cid)
+        sq = 0.0
+        n = 0
+        finite = True
+        for path in _coefficient_files(cdir):
+            for rec in read_avro_records(path):
+                n += 1
+                for ntv in rec.get("means") or ():
+                    v = float(ntv["value"])
+                    if not math.isfinite(v):
+                        finite = False
+                    else:
+                        sq += v * v
+                for ntv in rec.get("variances") or ():
+                    if not math.isfinite(float(ntv["value"])):
+                        finite = False
+        out[cid] = {"l2": math.sqrt(sq), "records": n, "finite": finite}
+    return out
+
+
+@dataclasses.dataclass
+class GateResult:
+    """Verdict of the validation gate for one candidate generation."""
+
+    ok: bool
+    reason: Optional[str]
+    checks: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+def _metric_regressed(name: str, new: float, old: float, tol: float) -> bool:
+    """True when ``new`` is worse than ``old`` by more than ``tol``, with
+    the metric's own direction (AUC up-is-better, RMSE down-is-better —
+    EvaluatorSpec grammar). Unknown metric names are not judged."""
+    import math
+
+    if not (math.isfinite(new) and math.isfinite(old)):
+        return not math.isfinite(new)  # a non-finite NEW metric always fails
+    try:
+        from photon_tpu.evaluation.suite import EvaluatorSpec
+
+        better = EvaluatorSpec.parse(name).better()
+    except Exception:  # noqa: BLE001 — unknown metric: no regression verdict
+        return False
+    if better(1.0, 0.0):  # higher is better
+        return new < old - tol
+    return new > old + tol
+
+
+def verify_generation(
+    model_dir: str,
+    parent_dir: Optional[str] = None,
+    metric_tolerance: float = 0.02,
+    norm_drift_bound: float = 10.0,
+) -> GateResult:
+    """The validation gate. Three passes, all against re-derived facts:
+
+    1. **Checksums** — every file the manifest lists must exist and hash to
+       the recorded sha256 (catches torn copies AND bit-rot that still
+       deserializes).
+    2. **Coefficient sanity** — every persisted coefficient finite; each
+       coordinate's L2 norm within ``norm_drift_bound`` relative drift of
+       the parent's (a re-train that exploded the weights is wrong even if
+       its own holdout number looks fine).
+    3. **Holdout regression** — each metric recorded in both manifests must
+       not be worse than the parent's by more than ``metric_tolerance``,
+       judged in the metric's own direction.
+
+    Never raises on bad content — returns ``GateResult(ok=False, reason)``;
+    the caller decides whether that blocks publication."""
+    checks: Dict[str, object] = {}
+    manifest = None
+    try:
+        manifest = load_generation_manifest(model_dir)
+    except (OSError, ValueError) as exc:
+        return GateResult(False, f"manifest_unreadable: {exc}", checks)
+    if manifest is None:
+        return GateResult(False, "manifest_missing", checks)
+
+    # 1. checksums
+    recorded = manifest.get("files") or {}
+    for rel, digest in sorted(recorded.items()):
+        path = os.path.join(model_dir, rel)
+        if not os.path.exists(path):
+            return GateResult(False, f"missing_file: {rel}", checks)
+        actual = _file_sha256(path)
+        if actual != digest:
+            return GateResult(False, f"checksum_mismatch: {rel}", checks)
+    checks["files_verified"] = len(recorded)
+
+    # 2. coefficient sanity (+ norm drift vs parent)
+    try:
+        norms = coordinate_norms(model_dir)
+    except Exception as exc:  # noqa: BLE001 — unreadable coefficients fail the gate
+        return GateResult(False, f"coefficients_unreadable: {exc}", checks)
+    checks["coordinate_norms"] = {c: round(v["l2"], 6) for c, v in norms.items()}
+    for cid, info in norms.items():
+        if not info["finite"]:
+            return GateResult(False, f"non_finite_coefficients: {cid}", checks)
+    parent_manifest = None
+    if parent_dir:
+        try:
+            parent_manifest = load_generation_manifest(parent_dir)
+            parent_norms = coordinate_norms(parent_dir)
+        except Exception:  # noqa: BLE001 — an unreadable parent cannot bound us
+            parent_norms = {}
+        for cid, info in norms.items():
+            old = parent_norms.get(cid, {}).get("l2")
+            if old is None or old <= 1e-9:
+                continue
+            drift = abs(info["l2"] - old) / old
+            if drift > norm_drift_bound:
+                return GateResult(
+                    False,
+                    f"norm_drift: {cid} drifted {drift:.2f}x "
+                    f"(bound {norm_drift_bound})",
+                    checks,
+                )
+
+    # 3. holdout regression vs parent
+    new_metrics = manifest.get("holdoutMetrics") or {}
+    old_metrics = (parent_manifest or {}).get("holdoutMetrics") or {}
+    compared = {}
+    for name, new_v in new_metrics.items():
+        old_v = old_metrics.get(name)
+        if old_v is None:
+            continue
+        compared[name] = {"new": new_v, "parent": old_v}
+        if _metric_regressed(name, float(new_v), float(old_v), metric_tolerance):
+            checks["holdout_compared"] = compared
+            return GateResult(
+                False,
+                f"holdout_regression: {name} {new_v:.6g} vs parent "
+                f"{old_v:.6g} (tolerance {metric_tolerance})",
+                checks,
+            )
+    checks["holdout_compared"] = compared
+    return GateResult(True, None, checks)
+
+
+def gate_and_publish(
+    publish_root: str,
+    generation: str,
+    metric_tolerance: float = 0.02,
+    norm_drift_bound: float = 10.0,
+) -> GateResult:
+    """Run the validation gate on ``generation`` (a subdir of
+    ``publish_root``) against the CURRENT ``LATEST`` generation, then flip
+    the pointer only on a pass. A failing generation is left on disk with
+    the refusal reason written into its own manifest's gate record and a
+    ``model_gate_failures_total`` count — candidate forever, published
+    never."""
+    from photon_tpu.obs.metrics import registry
+
+    model_dir = os.path.join(publish_root, generation)
+    parent_dir = None
+    latest = os.path.join(publish_root, "LATEST")
+    if os.path.isfile(latest):
+        with open(latest) as f:
+            name = f.read().strip()
+        if name and name != generation:
+            cand = name if os.path.isabs(name) else os.path.join(publish_root, name)
+            if os.path.isdir(cand):
+                parent_dir = cand
+    result = verify_generation(
+        model_dir, parent_dir,
+        metric_tolerance=metric_tolerance,
+        norm_drift_bound=norm_drift_bound,
+    )
+    manifest = load_generation_manifest(model_dir)
+    if manifest is not None:
+        manifest["gate"] = {
+            "status": "published" if result.ok else "rejected",
+            "reason": result.reason,
+            "checkedAt": time.time(),
+        }
+        _write_json_durable(os.path.join(model_dir, MANIFEST_FILE), manifest)
+    if result.ok:
+        publish_latest_pointer(publish_root, generation)
+        registry().counter("model_generations_published_total").inc()
+        logger.info("generation %s passed the gate; LATEST -> %s",
+                    generation, generation)
+    else:
+        registry().counter("model_gate_failures_total").inc()
+        logger.warning(
+            "generation %s REFUSED by the validation gate (%s); LATEST "
+            "unchanged", generation, result.reason,
+        )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Poison list: generations that must never be (re-)promoted. Lives beside
+# the manifests in the publish root, one durable JSON object
+# {generation: reason}; the serving watcher both writes it (rollback, reload
+# exhaustion) and consults it before loading anything.
+# ---------------------------------------------------------------------------
+
+
+def load_poison_list(publish_root: str) -> Dict[str, str]:
+    path = os.path.join(publish_root, POISON_FILE)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        return {str(k): str(v) for k, v in obj.items()}
+    except (OSError, ValueError):
+        return {}
+
+
+def mark_poisoned(publish_root: str, generation: str, reason: str) -> None:
+    """Durably add ``generation`` to the publish root's poison list."""
+    generation = os.path.basename(generation.rstrip("/"))
+    poisoned = load_poison_list(publish_root)
+    poisoned[generation] = reason
+    _write_json_durable(os.path.join(publish_root, POISON_FILE), poisoned)
+    logger.warning("generation %s marked POISONED: %s", generation, reason)
+
+
+def is_poisoned(publish_root: str, generation: str) -> bool:
+    return os.path.basename(generation.rstrip("/")) in load_poison_list(
+        publish_root
+    )
+
+
+def next_generation_name(publish_root: str, prefix: str = "gen-") -> str:
+    """First unused ``<prefix><N>`` under the publish root (N counts up from
+    the numerically largest existing generation, poisoned ones included)."""
+    best = 0
+    if os.path.isdir(publish_root):
+        for name in os.listdir(publish_root):
+            if name.startswith(prefix):
+                try:
+                    best = max(best, int(name[len(prefix):]))
+                except ValueError:
+                    continue
+    return f"{prefix}{best + 1}"
 
 
 def _scan_model_dir(model_dir: str, meta: dict) -> Dict[str, dict]:

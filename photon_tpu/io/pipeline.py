@@ -42,6 +42,7 @@ BENCH_FULL.md's stage-timing section.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import queue
@@ -75,6 +76,7 @@ TRANSIENT_ERRORS = (OSError, TimeoutError)
 
 MAX_RETRIES_ENV = "PHOTON_TPU_PIPELINE_MAX_RETRIES"
 SKIP_BUDGET_ENV = "PHOTON_TPU_PIPELINE_SKIP_BUDGET"
+DEAD_LETTER_ENV = "PHOTON_TPU_PIPELINE_DEAD_LETTER"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,6 +87,7 @@ class RetryPolicy:
     ``skip_budget=0`` (default) keeps the historical fail-fast behavior."""
 
     max_retries: int = 2
+    dead_letter_path: Optional[str] = None  # JSONL sidecar for skipped chunks
     backoff_s: float = 0.05
     backoff_max_s: float = 2.0
     jitter: float = 0.25
@@ -98,19 +101,27 @@ def default_retry_policy() -> RetryPolicy:
     p = RetryPolicy()
     mr = os.environ.get(MAX_RETRIES_ENV, "").strip()
     sb = os.environ.get(SKIP_BUDGET_ENV, "").strip()
+    dl = os.environ.get(DEAD_LETTER_ENV, "").strip()
     if mr:
         p = dataclasses.replace(p, max_retries=int(mr))
     if sb:
         p = dataclasses.replace(p, skip_budget=int(sb))
+    if dl:
+        p = dataclasses.replace(p, dead_letter_path=dl)
     return p
 
 
 class _SkipBudget:
-    """Pipeline-wide poisoned-chunk budget (thread-safe)."""
+    """Pipeline-wide poisoned-chunk budget (thread-safe). With a
+    ``dead_letter_path``, every consumed skip appends one JSONL record
+    naming the dropped chunk — skipped data becomes targetable by the
+    incremental driver's next refresh (``--dead-letter-in``) instead of
+    silently lost."""
 
-    def __init__(self, limit: int):
+    def __init__(self, limit: int, dead_letter_path: Optional[str] = None):
         self.limit = int(limit)
         self.used = 0
+        self.dead_letter_path = dead_letter_path
         self._lock = threading.Lock()
 
     def try_consume(self) -> bool:
@@ -119,6 +130,26 @@ class _SkipBudget:
                 return False
             self.used += 1
             return True
+
+    def dead_letter(self, stage: str, item, exc: BaseException) -> None:
+        if not self.dead_letter_path:
+            return
+        record = dict(
+            stage=stage,
+            chunk=getattr(item, "index", None),
+            rows=getattr(item, "n", None),
+            error=f"{type(exc).__name__}: {exc}",
+            ts=time.time(),
+        )
+        try:
+            with self._lock:
+                with open(self.dead_letter_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+        except OSError:
+            logger.exception(
+                "could not append dead-letter record to %s",
+                self.dead_letter_path,
+            )
 
 
 def _with_retries(
@@ -173,6 +204,7 @@ def _retry_or_skip(
     except Exception as exc:  # noqa: BLE001 — budget decision, then re-raise
         if skips.try_consume():
             registry().counter("pipeline_chunks_skipped_total", stage=name).inc()
+            skips.dead_letter(name, item, exc)
             logger.warning(
                 "pipeline stage %s: skipping poisoned chunk after retries "
                 "(%s); skip budget %d/%d used",
@@ -355,7 +387,7 @@ def _run_staged(
     budget to every stage (and ``source_hook``, run per item after the
     source yields it); both paths apply identical retry/skip semantics."""
     policy = retry if retry is not None else default_retry_policy()
-    skips = _SkipBudget(policy.skip_budget)
+    skips = _SkipBudget(policy.skip_budget, policy.dead_letter_path)
     # Per-stage RNGs so jitter streams are independent yet deterministic
     # for a fixed policy.seed regardless of thread interleaving.
     src_rng = np.random.default_rng(policy.seed)

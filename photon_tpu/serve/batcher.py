@@ -55,6 +55,11 @@ class ScoreRequest:
     entity_ids: Dict[str, object] = dataclasses.field(default_factory=dict)
     offset: float = 0.0
     uid: Optional[object] = None
+    # Version pin: None scores on the engine's primary generation; a set
+    # value is resolved (exact key or basename) against the resident
+    # versions at submit time — unknown pins raise there, on the caller's
+    # thread, never inside a batch.
+    model_version: Optional[str] = None
 
 
 @dataclasses.dataclass

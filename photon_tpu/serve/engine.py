@@ -46,6 +46,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -85,6 +86,8 @@ class ServeConfig:
     breaker_threshold: int = 3  # consecutive resolve failures to trip
     breaker_cooldown_s: float = 30.0  # open duration before half-open probe
     admission: Optional[AdmissionConfig] = None  # per-tenant quotas/classes
+    max_versions: int = 2  # resident generations (primary + candidates)
+    shadow_fraction: float = 0.0  # of primary traffic re-scored on shadow
 
 
 class _Breaker:
@@ -169,7 +172,23 @@ class ServingEngine:
         # front-end workers — quota state must be globally consistent no
         # matter how many processes fan requests in.
         self.admission = AdmissionController(self.config.admission)
-        self._state = self._build_state(model, model_version)
+        # Multi-version residency: every generation is a full _State (its own
+        # store + transformer + warm-up), but versions differ only by table
+        # VALUES, so marginal versions cost memory — never a live-path
+        # compile. ``_primary`` answers unpinned traffic; ``_shadow``, when
+        # set, re-scores a deterministic sample of primary traffic without
+        # touching responses.
+        state = self._build_state(model, model_version)
+        self._states: Dict[str, _State] = {state.model_version: state}
+        self._primary: str = state.model_version
+        self._shadow: Optional[str] = None
+        self._shadow_fraction = float(self.config.shadow_fraction)
+        self._shadow_acc = 0.0  # fractional-sampling accumulator
+        self._shadow_samples: deque = deque(maxlen=256)
+        self._shadow_count = 0
+        self._shadow_div_sum = 0.0
+        self._shadow_div_max = 0.0
+        self._promotion: Optional[Dict] = None
         self.batcher = MicroBatcher(
             self._score_batch,
             max_batch_size=self.max_batch,
@@ -337,21 +356,106 @@ class ServingEngine:
 
     # -- the batcher's score_fn --------------------------------------------
 
-    def _score_batch(self, requests: List[ScoreRequest]) -> Sequence[float]:
+    @property
+    def _state(self) -> _State:
+        """The primary generation's state (legacy single-version alias)."""
+        return self._states[self._primary]
+
+    def _resolve_version(self, pin: Optional[str]) -> str:
+        """A version pin → resident state key: exact match, else basename
+        (callers pin ``gen-3``; the engine may key the full model dir).
+        Unknown pins raise ValueError (→ HTTP 400 in the front end)."""
+        if pin is None:
+            return self._primary
+        pin = str(pin)
+        if pin in self._states:
+            return pin
+        for key in self._states:
+            if os.path.basename(str(key).rstrip("/")) == pin:
+                return key
+        raise ValueError(
+            f"unknown model version {pin!r}; resident: "
+            f"{sorted(self.versions)}"
+        )
+
+    def _score_on(self, state: _State, requests: List[ScoreRequest]) -> np.ndarray:
         import jax
 
-        with self._lock:  # vs reload swap; store.resolve is single-writer
-            state = self._state
-            n = len(requests)
-            with tracer().span("score"):
-                faults.check("serve.score")
-                batch = self._assemble(requests, state.store)
-                batch = pad_game_batch(batch, bucket_dim(n), xp=np)
-                dev = jax.device_put(batch)
-                scores = state.transformer.transform(
-                    dev, model=state.store.scoring_model()
-                )
-                return np.asarray(scores)[:n]
+        n = len(requests)
+        with tracer().span("score"):
+            faults.check("serve.score")
+            batch = self._assemble(requests, state.store)
+            batch = pad_game_batch(batch, bucket_dim(n), xp=np)
+            dev = jax.device_put(batch)
+            scores = state.transformer.transform(
+                dev, model=state.store.scoring_model()
+            )
+            return np.asarray(scores)[:n]
+
+    def _score_batch(self, requests: List[ScoreRequest]) -> Sequence[float]:
+        with self._lock:  # vs promote/reload swap; store.resolve single-writer
+            out = np.zeros(len(requests), np.float32)
+            groups: Dict[str, List[int]] = {}
+            for i, r in enumerate(requests):
+                key = r.model_version or self._primary
+                if key not in self._states:
+                    # Pinned version evicted between submit and flush (a
+                    # promote/evict race): the primary answers rather than
+                    # failing the whole batch.
+                    key = self._primary
+                groups.setdefault(key, []).append(i)
+            for key, idxs in groups.items():
+                sub = [requests[i] for i in idxs]
+                scores = self._score_on(self._states[key], sub)
+                out[idxs] = scores
+                if key == self._primary and self._shadow in self._states:
+                    self._maybe_shadow_score(sub, scores)
+            return out
+
+    def _maybe_shadow_score(
+        self, requests: List[ScoreRequest], primary_scores: np.ndarray
+    ) -> None:
+        """Re-score a deterministic ``shadow_fraction`` sample of primary
+        traffic on the shadow generation, recording score divergence.
+        Responses are untouched — shadow cost is observability only, and a
+        shadow failure degrades to "no sample", never to a caller error.
+
+        Fault site ``serve.shadow_diverge`` perturbs the shadow scores so
+        the watcher's divergence bound must refuse the candidate."""
+        take: List[int] = []
+        for i in range(len(requests)):
+            self._shadow_acc += self._shadow_fraction
+            if self._shadow_acc >= 1.0:
+                self._shadow_acc -= 1.0
+                take.append(i)
+        if not take:
+            return
+        state = self._states[self._shadow]
+        try:
+            shadow_scores = np.asarray(
+                self._score_on(state, [requests[i] for i in take]), np.float32
+            )
+        except Exception as exc:  # noqa: BLE001 — shadow never hurts callers
+            registry().counter("serve_shadow_errors_total").inc()
+            logger.warning(
+                "serving: shadow scoring on %r failed: %s", self._shadow, exc
+            )
+            return
+        if faults.injector().fire("serve.shadow_diverge") is not None:
+            shadow_scores = shadow_scores + 1.0
+        reg = registry()
+        hist = reg.histogram("serve_shadow_divergence")
+        for j, i in enumerate(take):
+            p, s = float(primary_scores[i]), float(shadow_scores[j])
+            div = abs(s - p)
+            hist.observe(div)
+            self._shadow_count += 1
+            self._shadow_div_sum += div
+            self._shadow_div_max = max(self._shadow_div_max, div)
+            self._shadow_samples.append(
+                dict(uid=requests[i].uid, primary=p, shadow=s, divergence=div)
+            )
+        reg.counter("serve_shadow_scored_total").inc(len(take))
 
     # -- public API ---------------------------------------------------------
 
@@ -361,11 +465,20 @@ class ServingEngine:
         deadline_s: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: str = INTERACTIVE,
+        model_version: Optional[str] = None,
     ):
         """Admit (quota + priority class), then enqueue. Shed requests
         raise on THIS thread (``QuotaExceededError``/``BackpressureError``,
         both → HTTP 429); admitted requests return a Future and report
-        their end-to-end latency into ``serve_tenant_latency_s``."""
+        their end-to-end latency into ``serve_tenant_latency_s``.
+
+        ``model_version`` (or ``request.model_version``) pins the request to
+        a resident generation; unknown pins raise ValueError here, on the
+        caller's thread."""
+        pin = model_version or request.model_version
+        if pin is not None:
+            with self._lock:
+                request.model_version = self._resolve_version(pin)
         if deadline_s is None and self.config.default_deadline_ms is not None:
             deadline_s = self.config.default_deadline_ms / 1000.0
         self.admission.admit(
@@ -391,6 +504,7 @@ class ServingEngine:
         deadline_s: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: str = INTERACTIVE,
+        model_version: Optional[str] = None,
     ) -> float:
         """Synchronous convenience wrapper: one request, blocking."""
         return self.submit(
@@ -398,57 +512,204 @@ class ServingEngine:
             deadline_s,
             tenant=tenant,
             priority=priority,
+            model_version=model_version,
         ).result()
 
     @property
     def model_version(self) -> str:
-        return self._state.model_version
+        return self._primary
+
+    @property
+    def versions(self) -> List[str]:
+        return list(self._states)
+
+    @property
+    def shadow_version(self) -> Optional[str]:
+        return self._shadow
 
     @property
     def retraces_since_warmup(self) -> int:
-        """0 is the contract; anything else means a live batch compiled."""
-        state = self._state
-        return state.transformer.trace_count - state.warm_traces
+        """0 is the contract; anything else means a live batch compiled.
+        Summed over every resident generation — a candidate that compiles
+        on live traffic is just as much a contract breach as the primary."""
+        return sum(
+            s.transformer.trace_count - s.warm_traces
+            for s in self._states.values()
+        )
 
-    def reload(self, model: GameModel, model_version: Optional[str] = None) -> Dict:
-        """Zero-downtime swap to ``model`` (host-side master). Builds and
-        warms the new generation OFF the scoring lock — the old state keeps
-        serving — then swaps under it, which also drains the in-flight
-        batch. Returns the new generation's stats.
+    def _total_trips(self) -> int:
+        return sum(b.trips for b in self._breakers.values())
 
-        A failed build/warm-up raises :class:`ReloadError` and leaves the
-        OLD state serving, untouched — the error is also visible in
-        ``stats()['last_reload_error']`` until a reload succeeds."""
+    def _evict_locked(self) -> None:
+        """Drop oldest resident generations beyond ``max_versions``. The
+        primary, the shadow, and the current promotion's parent (the
+        rollback target) are never evicted."""
+        cap = max(int(self.config.max_versions), 1)
+        keep = {self._primary, self._shadow}
+        if self._promotion is not None:
+            keep.add(self._promotion["parent"])
+        for key in list(self._states):
+            if len(self._states) <= cap:
+                break
+            if key in keep:
+                continue
+            del self._states[key]
+            logger.info("serving: evicted resident generation %r", key)
+
+    def load_version(
+        self, model: GameModel, model_version: Optional[str] = None
+    ) -> Dict:
+        """Build + warm ``model`` as a RESIDENT generation without touching
+        the primary. Traffic can pin to it immediately; ``start_shadow`` /
+        ``promote`` move it through the rollout lifecycle.
+
+        A failed build/warm-up raises :class:`ReloadError`; nothing resident
+        changes — the error is also visible in
+        ``stats()['last_reload_error']`` until a load succeeds."""
         self._reloads += 1
         version = model_version or f"reload-{self._reloads}"
         try:
             faults.check("serve.reload")
-            new_state = self._build_state(model, version)  # old state serving
-        except Exception as exc:  # noqa: BLE001 — keep the old model serving
+            new_state = self._build_state(model, version)  # off the lock
+        except Exception as exc:  # noqa: BLE001 — keep serving what we have
             self._reload_failures += 1
             self._last_reload_error = f"{version}: {exc}"
             registry().counter("serve_reload_failures_total").inc()
             logger.warning(
-                "serving: reload to %r failed (%s); previous model %r "
-                "keeps serving", version, exc, self._state.model_version,
+                "serving: load of %r failed (%s); resident generations "
+                "unchanged", version, exc,
             )
             raise ReloadError(
                 f"reload to {version!r} failed: {exc}"
             ) from exc
-        with tracer().span("serve/reload_swap"):
-            with self._lock:
-                self._state = new_state
+        with self._lock:
+            self._states[new_state.model_version] = new_state
+            self._evict_locked()
         self._last_reload_error = None
         registry().counter("serve_model_reloads_total").inc()
         return dict(model_version=version, store=new_state.store.stats())
+
+    def start_shadow(
+        self, model_version: str, fraction: Optional[float] = None
+    ) -> None:
+        """Mirror a sample of primary traffic onto a resident candidate.
+        Resets the divergence record so a quota check reads this shadow
+        phase only."""
+        with self._lock:
+            key = self._resolve_version(model_version)
+            if key == self._primary:
+                raise ValueError("cannot shadow the primary onto itself")
+            self._shadow = key
+            if fraction is not None:
+                self._shadow_fraction = float(fraction)
+            self._shadow_acc = 0.0
+            self._shadow_samples.clear()
+            self._shadow_count = 0
+            self._shadow_div_sum = 0.0
+            self._shadow_div_max = 0.0
+        logger.info(
+            "serving: shadowing %.3f of primary traffic onto %r",
+            self._shadow_fraction, key,
+        )
+
+    def stop_shadow(self) -> None:
+        with self._lock:
+            self._shadow = None
+
+    def shadow_stats(self) -> Dict:
+        return dict(
+            version=self._shadow,
+            count=self._shadow_count,
+            max_divergence=self._shadow_div_max,
+            mean_divergence=(
+                self._shadow_div_sum / self._shadow_count
+                if self._shadow_count
+                else 0.0
+            ),
+        )
+
+    def shadow_samples(self) -> List[Dict]:
+        """Recent (uid, primary, shadow) score pairs — the rollout soak's
+        bit-exactness evidence."""
+        with self._lock:
+            return list(self._shadow_samples)
+
+    def promote(self, model_version: str) -> Dict:
+        """Make a resident generation the primary, remembering the previous
+        primary as the ROLLBACK PARENT (pinned against eviction). The swap
+        happens under the scoring lock: in-flight batches drain on the old
+        primary, the next batch scores on the new one — same zero-downtime
+        story as reload, zero compiles because the state is already warm."""
+        with self._lock:
+            key = self._resolve_version(model_version)
+            if key == self._primary:
+                return dict(model_version=key, parent=None)
+            parent = self._primary
+            self._promotion = dict(
+                version=key,
+                parent=parent,
+                at=time.time(),
+                trips_at=self._total_trips(),
+            )
+            self._primary = key
+            if self._shadow == key:
+                self._shadow = None
+        registry().counter("serve_promotions_total").inc()
+        logger.info("serving: promoted %r (parent %r)", key, parent)
+        return dict(model_version=key, parent=parent)
+
+    def trips_since_promotion(self) -> int:
+        """Breaker trips since the last ``promote`` — the watcher's rollback
+        signal. 0 when nothing was promoted."""
+        promo = self._promotion
+        return self._total_trips() - promo["trips_at"] if promo else 0
+
+    def rollback(self, reason: str = "") -> Optional[str]:
+        """Demote the promoted generation back to its parent. Returns the
+        demoted version (for the caller to poison), or None when there is
+        no promotion to unwind or the parent is gone."""
+        with self._lock:
+            promo = self._promotion
+            if promo is None or promo["parent"] not in self._states:
+                return None
+            demoted = self._primary
+            self._primary = promo["parent"]
+            self._promotion = None
+            self._shadow = None
+        registry().counter("serve_rollbacks_total").inc()
+        logger.warning(
+            "serving: rolled back %r -> %r (%s)",
+            demoted, self._primary, reason or "no reason given",
+        )
+        return demoted
+
+    def reload(self, model: GameModel, model_version: Optional[str] = None) -> Dict:
+        """Zero-downtime swap to ``model``: load as a resident generation,
+        then promote it. The direct path (no shadow phase) — the rollout
+        watcher uses load_version/start_shadow/promote instead.
+
+        A failed build/warm-up raises :class:`ReloadError` and leaves the
+        OLD state serving, untouched — the error is also visible in
+        ``stats()['last_reload_error']`` until a reload succeeds."""
+        out = self.load_version(model, model_version)
+        with tracer().span("serve/reload_swap"):
+            self.promote(out["model_version"])
+        return out
 
     def stats(self) -> Dict:
         state = self._state
         degraded = sorted(
             rt for rt, b in self._breakers.items() if b.open
         )
+        promo = self._promotion
         return dict(
             model_version=state.model_version,
+            versions=sorted(self._states),
+            primary=self._primary,
+            shadow=self._shadow,
+            shadow_stats=self.shadow_stats(),
+            promotion=dict(promo) if promo else None,
+            trips_since_promotion=self.trips_since_promotion(),
             queue_depth=self.batcher.queue_depth,
             max_batch_size=self.max_batch,
             trace_count=state.transformer.trace_count,

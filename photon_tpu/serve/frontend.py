@@ -81,6 +81,7 @@ def request_from_json(obj: dict) -> ScoreRequest:
         entity_ids=dict(obj.get("entityIds", {})),
         offset=float(obj.get("offset", 0.0)),
         uid=obj.get("uid"),
+        model_version=obj.get("modelVersion"),
     )
 
 
@@ -310,6 +311,7 @@ class ScorerServer:
             req,
             tenant=msg.get("tenant"),
             priority=msg.get("priority") or INTERACTIVE,
+            model_version=msg.get("modelVersion"),
         )
 
         def _done(f: Future) -> None:
@@ -321,7 +323,11 @@ class ScorerServer:
                     id=rid, ok=True,
                     result=dict(
                         score=f.result(),
-                        modelVersion=self.engine.model_version,
+                        # submit() resolved the pin onto the request; an
+                        # unpinned request scored on the primary.
+                        modelVersion=(
+                            req.model_version or self.engine.model_version
+                        ),
                     ),
                 ))
 
@@ -461,9 +467,11 @@ class ScorerClient:
         raw_request: dict,
         tenant: Optional[str] = None,
         priority: str = INTERACTIVE,
+        model_version: Optional[str] = None,
     ) -> Future:
         return self.request(
-            "score", request=raw_request, tenant=tenant, priority=priority
+            "score", request=raw_request, tenant=tenant, priority=priority,
+            modelVersion=model_version,
         )
 
     def call(self, op: str, timeout_s: float = 30.0, **payload):
@@ -496,10 +504,13 @@ class LocalBackend:
         self.result_timeout_s = result_timeout_s
 
     def submit(
-        self, raw_request: dict, tenant: Optional[str], priority: str
+        self, raw_request: dict, tenant: Optional[str], priority: str,
+        model_version: Optional[str] = None,
     ) -> Future:
+        req = request_from_json(raw_request)
         src = self.engine.submit(
-            request_from_json(raw_request), tenant=tenant, priority=priority
+            req, tenant=tenant, priority=priority,
+            model_version=model_version,
         )
         dst: Future = Future()
 
@@ -510,7 +521,11 @@ class LocalBackend:
             else:
                 dst.set_result(dict(
                     score=f.result(),
-                    modelVersion=self.engine.model_version,
+                    # submit() resolved the pin onto the request; an
+                    # unpinned request scored on the primary.
+                    modelVersion=(
+                        req.model_version or self.engine.model_version
+                    ),
                 ))
 
         src.add_done_callback(_done)
@@ -545,9 +560,12 @@ class RemoteBackend:
         self.result_timeout_s = result_timeout_s
 
     def submit(
-        self, raw_request: dict, tenant: Optional[str], priority: str
+        self, raw_request: dict, tenant: Optional[str], priority: str,
+        model_version: Optional[str] = None,
     ) -> Future:
-        return self.client.submit_score(raw_request, tenant, priority)
+        return self.client.submit_score(
+            raw_request, tenant, priority, model_version
+        )
 
     def stats(self) -> dict:
         stats = self.client.call("stats", timeout_s=30.0)
@@ -568,7 +586,10 @@ def make_http_handler(backend):
     """The ONE endpoint implementation, parameterized by backend — local
     engine or remote scorer. Tenant comes from the ``X-Tenant`` header (or
     a per-request ``tenant`` field), priority from ``X-Priority`` /
-    ``priority`` (``interactive`` default, ``batch`` for bulk callers)."""
+    ``priority`` (``interactive`` default, ``batch`` for bulk callers),
+    and a version pin from ``X-Model-Version`` / ``modelVersion`` —
+    pinned requests score on that resident generation (400 on an unknown
+    pin); unpinned requests follow the primary."""
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -606,6 +627,12 @@ def make_http_handler(backend):
                 )
             return tenant, priority
 
+        def _model_version(self, obj: Optional[dict] = None):
+            version = self.headers.get("X-Model-Version")
+            if isinstance(obj, dict):
+                version = obj.get("modelVersion", version)
+            return version
+
         def do_GET(self):
             if self.path == "/healthz":
                 self._reply_json(200, backend.stats())
@@ -637,16 +664,19 @@ def make_http_handler(backend):
         def _score_one(self):
             obj = json.loads(self._body())
             tenant, priority = self._tenant_priority(obj)
-            res = backend.submit(obj, tenant, priority).result(
-                backend.result_timeout_s
-            )
+            res = backend.submit(
+                obj, tenant, priority, self._model_version(obj)
+            ).result(backend.result_timeout_s)
             self._reply_json(200, res)
 
         def _score_jsonl(self):
             tenant, priority = self._tenant_priority()
+            version = self._model_version()
             out = score_jsonl(
                 self._body(),
-                lambda obj: backend.submit(obj, tenant, priority),
+                lambda obj: backend.submit(
+                    obj, tenant, priority, obj.get("modelVersion", version)
+                ),
                 result_timeout_s=backend.result_timeout_s,
             )
             payload = "".join(json.dumps(o) + "\n" for o in out).encode()

@@ -1,0 +1,5 @@
+"""Training-side orchestration above the estimators: incremental
+generation-over-generation updates (train/incremental.py). The estimators
+stay pure "fit a model" machinery; this package owns the lifecycle glue —
+parent loading, changed-entity selection, merge, manifest, validation gate.
+"""

@@ -1,0 +1,327 @@
+"""Incremental generation updates: re-train only what changed, gate the rest.
+
+The continuous-rollout training half (ROADMAP "close the train→serve loop"):
+instead of re-fitting the whole population every refresh, an incremental
+update
+
+1. loads the PARENT generation (whatever ``LATEST`` points to) as the warm
+   start, with the publish root's index maps / entity indexes so slot
+   assignments stay stable across generations;
+2. trains on the DELTA batch only — the entities present in it are exactly
+   the "data changed" set, and the active-set machinery gives per-entity
+   convergence inside the passes;
+3. MERGES: changed entities take their freshly trained rows, unchanged
+   entities keep the parent's coefficients verbatim (not "approximately
+   preserved through the solver" — copied), new entities append;
+4. records a generation manifest (per-file sha256, parent id, holdout
+   metrics) and runs the validation gate; only a passing generation moves
+   the fsync'd LATEST pointer that serving watches.
+
+An entity quarantined (DIVERGED) in generation g keeps its warm-start row
+there by the solver's quarantine contract; when its data shows up in the
+g+1 delta it is simply a changed entity again — it re-enters the active set
+and trains from the warm start that survived the manifest round trip
+(tests/test_rollout.py exercises exactly this heal).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    ProjectedRandomEffectModel,
+    RandomEffectModel,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def changed_entity_mask(batch, re_type: str, num_entities: int) -> np.ndarray:
+    """(E,) bool — entities with at least one row in the delta batch. This
+    IS the "data changed" set: the delta reader only carries rows whose
+    data moved since the parent generation."""
+    mask = np.zeros(int(num_entities), bool)
+    eids = np.asarray(batch.entity_ids[re_type]).astype(np.int64)
+    valid = (eids >= 0) & (eids < num_entities)
+    mask[eids[valid]] = True
+    return mask
+
+
+def _dense_re(model) -> RandomEffectModel:
+    if isinstance(model, ProjectedRandomEffectModel):
+        return model.to_dense()
+    return model
+
+
+def merge_random_effect(
+    parent: Optional[RandomEffectModel],
+    trained: RandomEffectModel,
+    changed: np.ndarray,
+) -> RandomEffectModel:
+    """Row-level merge of one RE coordinate: changed rows from ``trained``,
+    everything else verbatim from ``parent``. Both models are sized to the
+    SAME entity space (the parent loads against the already-grown entity
+    index, so new entities exist as absent rows there)."""
+    trained = _dense_re(trained)
+    t_coefs = np.asarray(trained.coefficients, np.float32)
+    E, d = t_coefs.shape
+    changed = np.asarray(changed, bool)
+    if changed.shape[0] != E:
+        raise ValueError(
+            f"changed mask has {changed.shape[0]} entities, model has {E}"
+        )
+    if parent is None:
+        present = changed.copy()
+        coefs = np.where(changed[:, None], t_coefs, 0.0).astype(np.float32)
+        return RandomEffectModel(
+            coefs, trained.re_type, trained.feature_shard, trained.task,
+            None, present_entities=present,
+        )
+    parent = _dense_re(parent)
+    p_coefs = np.asarray(parent.coefficients, np.float32)
+    p_present = getattr(parent, "present_entities", None)
+    p_present = (
+        np.ones((p_coefs.shape[0],), bool)
+        if p_present is None
+        else np.asarray(p_present, bool)
+    )
+    if p_coefs.shape[1] != d:
+        raise ValueError(
+            f"parent dim {p_coefs.shape[1]} != trained dim {d} for RE "
+            f"coordinate {trained.re_type!r}"
+        )
+    coefs = np.zeros((E, d), np.float32)
+    present = np.zeros((E,), bool)
+    k = min(E, p_coefs.shape[0])
+    coefs[:k] = p_coefs[:k]
+    present[:k] = p_present[:k]
+    coefs[changed] = t_coefs[changed]
+    present |= changed
+    variances = None
+    if trained.variances is not None and parent.variances is not None:
+        variances = np.zeros((E, d), np.float32)
+        variances[:k] = np.asarray(parent.variances, np.float32)[:k]
+        variances[changed] = np.asarray(trained.variances, np.float32)[changed]
+    return RandomEffectModel(
+        coefs, trained.re_type, trained.feature_shard, trained.task,
+        variances, present_entities=present,
+    )
+
+
+def merge_models(
+    parent: Optional[GameModel],
+    trained: GameModel,
+    changed_masks: Dict[str, np.ndarray],
+) -> GameModel:
+    """Generation merge: fixed effects take the (warm-started) retrain;
+    random effects merge row-wise per ``changed_masks[re_type]``."""
+    merged: Dict[str, object] = {}
+    for cid, sub in trained.models.items():
+        if isinstance(sub, FixedEffectModel):
+            merged[cid] = sub
+            continue
+        p_sub = parent.get(cid) if parent is not None else None
+        dense = _dense_re(sub)
+        changed = changed_masks.get(dense.re_type)
+        if changed is None:
+            changed = np.ones((np.asarray(dense.coefficients).shape[0],), bool)
+        merged[cid] = merge_random_effect(p_sub, dense, changed)
+    return GameModel(merged)
+
+
+def compute_holdout_metrics(model: GameModel, batch, suite) -> Dict[str, float]:
+    """Holdout-metric record for the generation manifest — scored with the
+    MERGED model (what would serve), not the raw retrain.
+
+    Fault site ``model.bad_holdout`` simulates a refresh that silently got
+    worse: each metric is pushed past any sane regression tolerance in its
+    own worse direction, so the gate's holdout pass must refuse the
+    generation."""
+    from photon_tpu.utils import faults
+
+    metrics = suite.evaluate_model(model, batch)
+    rule = faults.injector().fire("model.bad_holdout")
+    if rule is not None:
+        from photon_tpu.evaluation.suite import EvaluatorSpec
+
+        bad = {}
+        for name, v in metrics.items():
+            try:
+                higher_better = EvaluatorSpec.parse(name).better()(1.0, 0.0)
+            except Exception:  # noqa: BLE001 — unknown metric: degrade anyway
+                higher_better = True
+            bad[name] = v - 0.5 if higher_better else v * 10.0 + 1.0
+        logger.warning(
+            "fault model.bad_holdout: recorded metrics degraded %s -> %s",
+            metrics, bad,
+        )
+        metrics = bad
+    return metrics
+
+
+def read_dead_letters(paths: Sequence[str]) -> List[dict]:
+    """Parse pipeline dead-letter sidecar JSONL files (io/pipeline.py writes
+    one record per dropped chunk). The incremental driver records these in
+    the generation manifest so the skipped rows are targeted — visibly, not
+    silently lost — by the next refresh."""
+    out: List[dict] = []
+    for path in paths:
+        if not path or not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    logger.warning("unparseable dead-letter line in %s", path)
+    return out
+
+
+@dataclasses.dataclass
+class IncrementalResult:
+    generation: str
+    model_dir: str
+    published: bool
+    gate_reason: Optional[str]
+    holdout_metrics: Dict[str, float]
+    changed_entities: Dict[str, int]
+    parent: Optional[str]
+
+
+def incremental_update(
+    publish_root: str,
+    batch,
+    index_maps: Dict,
+    entity_indexes: Dict,
+    task,
+    coordinate_configs: Sequence,
+    update_sequence: Sequence[str],
+    valid_batch=None,
+    evaluation_suite=None,
+    generation: Optional[str] = None,
+    locked_coordinates: Sequence[str] = (),
+    num_iterations: int = 1,
+    metric_tolerance: float = 0.02,
+    norm_drift_bound: float = 10.0,
+    sparsity_threshold: float = 0.0,
+    re_convergence_tol: float = 1e-4,
+    dead_letters: Optional[List[dict]] = None,
+    publish: bool = True,
+) -> IncrementalResult:
+    """One incremental generation, end to end: warm-start train on the
+    delta ``batch`` → merge over the parent → save → manifest → gate →
+    (maybe) publish. ``entity_indexes`` must already contain the delta's
+    interning — the parent loads against it so every array is sized to the
+    grown entity space.
+
+    ``sparsity_threshold`` defaults to 0 (exact round trip): an incremental
+    chain re-loads its own output as the next warm start, and thresholding
+    would decay coefficients a little every generation."""
+    from photon_tpu.cli.game_serving import resolve_model_dir
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        load_game_model,
+        next_generation_name,
+        save_game_model,
+        write_generation_manifest,
+    )
+
+    parent_dir = resolve_model_dir(publish_root)
+    has_parent = parent_dir != publish_root and os.path.isdir(parent_dir)
+    parent_name = os.path.basename(parent_dir.rstrip("/")) if has_parent else None
+    parent = None
+    if has_parent:
+        parent = load_game_model(parent_dir, index_maps, entity_indexes)
+
+    num_entities = {k: len(v) for k, v in entity_indexes.items()}
+    changed_masks = {
+        re_type: changed_entity_mask(batch, re_type, E)
+        for re_type, E in num_entities.items()
+        if re_type in batch.entity_ids
+    }
+    changed_counts = {k: int(v.sum()) for k, v in changed_masks.items()}
+    logger.info(
+        "incremental update: parent=%s changed entities=%s",
+        parent_name, changed_counts,
+    )
+
+    estimator = GameEstimator(
+        task=task,
+        coordinate_configs=list(coordinate_configs),
+        num_iterations=num_iterations,
+        num_entities=num_entities,
+        locked_coordinates=list(locked_coordinates),
+        warm_start_model=parent,
+        ignore_threshold_for_new_models=parent is not None,
+        re_active_set=True,
+        re_convergence_tol=re_convergence_tol,
+    )
+    results = estimator.fit(
+        batch,
+        validation_batch=valid_batch,
+        evaluation_suite=(
+            evaluation_suite if valid_batch is not None else None
+        ),
+        initial_model=parent,
+    )
+    best = (
+        estimator.select_best(results, evaluation_suite)
+        if evaluation_suite is not None and valid_batch is not None
+        else results[-1]
+    )
+    merged = merge_models(parent, best.model, changed_masks)
+
+    holdout: Dict[str, float] = {}
+    if valid_batch is not None and evaluation_suite is not None:
+        holdout = compute_holdout_metrics(merged, valid_batch, evaluation_suite)
+
+    generation = generation or next_generation_name(publish_root)
+    model_dir = os.path.join(publish_root, generation)
+    save_game_model(
+        merged, model_dir, index_maps, entity_indexes,
+        sparsity_threshold=sparsity_threshold,
+    )
+    # Entity indexes grew with the delta's new entities; persist them BEFORE
+    # the pointer can move so a reloading server resolves every slot the new
+    # generation references. (Interning is append-only: existing slots are
+    # stable, so the running server's copy stays valid too.)
+    for shard, imap in index_maps.items():
+        imap.save(os.path.join(publish_root, f"index-map-{shard}.json"))
+    for re_type, eidx in entity_indexes.items():
+        eidx.save(os.path.join(publish_root, f"entity-index-{re_type}.json"))
+    extra = {"changedEntities": changed_counts}
+    if dead_letters:
+        extra["deadLetterChunks"] = dead_letters
+    write_generation_manifest(
+        model_dir, parent=parent_name, holdout_metrics=holdout, extra=extra
+    )
+    if publish:
+        gate = gate_and_publish(
+            publish_root, generation,
+            metric_tolerance=metric_tolerance,
+            norm_drift_bound=norm_drift_bound,
+        )
+        published, reason = gate.ok, gate.reason
+    else:
+        published, reason = False, "publish_disabled"
+    return IncrementalResult(
+        generation=generation,
+        model_dir=model_dir,
+        published=published,
+        gate_reason=reason,
+        holdout_metrics=holdout,
+        changed_entities=changed_counts,
+        parent=parent_name,
+    )

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import hashlib
 import json
 import logging
 import os
@@ -124,6 +125,13 @@ def _encode(obj: Any, arrays: list) -> Any:
         kind = "scalar" if obj.__class__.__module__ == "numpy" and arr.ndim == 0 else "array"
         return {
             "t": kind, "i": idx, "shape": list(arr.shape), "dtype": dt,
+            # Payload digest: shape/dtype validation catches structural
+            # corruption, but bit-rot inside the data blocks deserializes
+            # fine and would silently poison a resume. Verified on load
+            # (when present — older checkpoints without it still load).
+            "sha256": hashlib.sha256(
+                np.ascontiguousarray(arr).tobytes()
+            ).hexdigest(),
         }
     if isinstance(obj, (list, tuple)):
         return {
@@ -176,6 +184,16 @@ def _decode(spec: Any, z) -> Any:
                 f"{arr.dtype}{arr.shape}, manifest says "
                 f"{spec['dtype']}{tuple(spec['shape'])}"
             )
+        want = spec.get("sha256")
+        if want is not None:
+            got = hashlib.sha256(
+                np.ascontiguousarray(arr).tobytes()
+            ).hexdigest()
+            if got != want:
+                raise ValueError(
+                    f"checkpoint corrupt: leaf {spec['i']} sha256 mismatch "
+                    f"(payload bit-rot): {got[:12]} != manifest {want[:12]}"
+                )
         if spec["dtype"] == "bfloat16":
             return jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
         if t == "scalar":

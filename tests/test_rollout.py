@@ -1,0 +1,955 @@
+"""Safe continuous rollout (ISSUE 8).
+
+Covers the tentpole end to end: generation manifests with per-file
+checksums and the three-pass validation gate (io/model_io.py), the
+multi-version serving engine — per-request version pins, shadow scoring,
+promote/rollback (serve/engine.py) — the watcher rollout state machine
+with retry/backoff + poison list (cli/game_serving.py), incremental
+retraining that keeps unchanged entities verbatim (train/incremental.py),
+and the satellites: checkpoint payload sha256 (utils/checkpoint.py),
+pipeline dead-letter sidecar (io/pipeline.py), and quarantine heal across
+generations.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.index_map import EntityIndex, IndexMap
+from photon_tpu.estimators.game_transformer import GameTransformer
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.types import TaskType
+from photon_tpu.utils import faults
+from photon_tpu.utils.faults import FaultPlan, FaultRule
+
+rng = np.random.default_rng(57)
+
+D_FIX, D_RE, N_ENTITIES = 6, 4, 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Every test starts AND ends with no fault plan: a leaked injector
+    would poison unrelated tests through the process-global hook sites."""
+    monkeypatch.delenv(faults.FAULT_PLAN_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_model(scale=1.0, seed=0):
+    r = np.random.default_rng(seed)
+    w_fix = (scale * np.linspace(-1, 1, D_FIX)).astype(np.float32)
+    w_re = (scale * r.normal(size=(N_ENTITIES, D_RE))).astype(np.float32)
+    return GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(np.asarray(w_fix)), TaskType.LOGISTIC_REGRESSION
+            ),
+            "shardA",
+        ),
+        "per_user": RandomEffectModel(
+            np.asarray(w_re), "userId", "shardB", TaskType.LOGISTIC_REGRESSION
+        ),
+    })
+
+
+def make_entity_index(n=N_ENTITIES):
+    eidx = EntityIndex()
+    for e in range(n):
+        eidx.intern(f"user{e}")
+    return eidx
+
+
+def make_index_maps():
+    return {
+        "shardA": IndexMap.build([f"a{j}" for j in range(D_FIX)]),
+        "shardB": IndexMap.build([f"b{j}" for j in range(D_RE)]),
+    }
+
+
+def batch_scores(model, xa, xb, users):
+    import jax
+
+    n = len(users)
+    b = GameBatch(
+        label=jnp.zeros(n, jnp.float32), offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+        features={"shardA": jnp.asarray(xa), "shardB": jnp.asarray(xb)},
+        entity_ids={"userId": jnp.asarray(np.asarray(users), jnp.int32)},
+    )
+    return np.asarray(GameTransformer(jax.device_put(model)).transform(b),
+                      np.float32)
+
+
+def _publish_gen(root, gen, scale, holdout=None, gate=True):
+    """Training-side publication with a generation manifest: save, write
+    the manifest (per-file checksums + holdout record), run the gate."""
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        save_game_model,
+        write_generation_manifest,
+    )
+
+    model = make_model(scale, seed=int(scale * 10))
+    imaps = make_index_maps()
+    eidx = make_entity_index()
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    save_game_model(model, os.path.join(root, gen), imaps, {"userId": eidx},
+                    sparsity_threshold=0.0)
+    write_generation_manifest(os.path.join(root, gen), parent=None,
+                              holdout_metrics=holdout or {"AUC": 0.9})
+    if gate:
+        res = gate_and_publish(root, gen)
+        assert res.ok, res.reason
+    return model
+
+
+# ---------------------------------------------------------------------------
+# Generation manifest + validation gate
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_verify_ok(tmp_path):
+    from photon_tpu.io.model_io import (
+        load_generation_manifest,
+        verify_generation,
+    )
+
+    root = str(tmp_path)
+    _publish_gen(root, "gen-1", 1.0, holdout={"AUC": 0.91})
+    man = load_generation_manifest(os.path.join(root, "gen-1"))
+    assert man["generation"] == "gen-1" and man["parent"] is None
+    assert man["holdoutMetrics"] == {"AUC": 0.91}
+    assert man["gate"]["status"] == "published"
+    # Every payload file is checksummed; the manifest itself is excluded.
+    assert man["files"] and all(len(h) == 64 for h in man["files"].values())
+    res = verify_generation(os.path.join(root, "gen-1"))
+    assert res.ok and res.reason is None
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == "gen-1"
+
+
+def test_gate_refuses_checksum_mismatch_and_keeps_latest(tmp_path):
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        load_generation_manifest,
+        save_game_model,
+        verify_generation,
+        write_generation_manifest,
+    )
+    from photon_tpu.obs.metrics import registry
+
+    root = str(tmp_path)
+    _publish_gen(root, "gen-1", 1.0)
+    # gen-2: bit-rot one payload file AFTER the manifest captured digests.
+    model = make_model(2.0)
+    save_game_model(model, os.path.join(root, "gen-2"), make_index_maps(),
+                    {"userId": make_entity_index()}, sparsity_threshold=0.0)
+    write_generation_manifest(os.path.join(root, "gen-2"), parent="gen-1",
+                              holdout_metrics={"AUC": 0.9})
+    man = load_generation_manifest(os.path.join(root, "gen-2"))
+    victim = sorted(man["files"])[0]
+    path = os.path.join(root, "gen-2", victim)
+    with open(path, "r+b") as f:
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]))
+
+    res = verify_generation(os.path.join(root, "gen-2"))
+    assert not res.ok and res.reason.startswith("checksum_mismatch:")
+
+    before = registry().counter("model_gate_failures_total").value
+    gate = gate_and_publish(root, "gen-2")
+    assert not gate.ok and "checksum_mismatch" in gate.reason
+    assert registry().counter("model_gate_failures_total").value == before + 1
+    # The failing generation stays on disk (forensics) but is never LATEST.
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == "gen-1"
+    man = load_generation_manifest(os.path.join(root, "gen-2"))
+    assert man["gate"]["status"] == "rejected"
+    assert "checksum_mismatch" in man["gate"]["reason"]
+
+
+def test_gate_refuses_holdout_regression(tmp_path):
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        save_game_model,
+        write_generation_manifest,
+    )
+
+    root = str(tmp_path)
+    _publish_gen(root, "gen-1", 1.0, holdout={"AUC": 0.9})
+    model = make_model(2.0)
+    save_game_model(model, os.path.join(root, "gen-2"), make_index_maps(),
+                    {"userId": make_entity_index()}, sparsity_threshold=0.0)
+    write_generation_manifest(os.path.join(root, "gen-2"), parent="gen-1",
+                              holdout_metrics={"AUC": 0.5})
+    gate = gate_and_publish(root, "gen-2")
+    assert not gate.ok and gate.reason.startswith("holdout_regression:")
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == "gen-1"
+    # Within tolerance passes: AUC is higher-is-better and 0.895 ≥ 0.9-0.02.
+    write_generation_manifest(os.path.join(root, "gen-2"), parent="gen-1",
+                              holdout_metrics={"AUC": 0.895})
+    gate = gate_and_publish(root, "gen-2")
+    assert gate.ok, gate.reason
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == "gen-2"
+
+
+def test_poison_list_and_generation_names(tmp_path):
+    from photon_tpu.io.model_io import (
+        is_poisoned,
+        load_poison_list,
+        mark_poisoned,
+        next_generation_name,
+    )
+
+    root = str(tmp_path)
+    assert next_generation_name(root) == "gen-1"
+    os.makedirs(os.path.join(root, "gen-1"))
+    os.makedirs(os.path.join(root, "gen-7"))
+    assert next_generation_name(root) == "gen-8"
+
+    assert not is_poisoned(root, "gen-7")
+    # Full paths and trailing slashes normalize to the basename.
+    mark_poisoned(root, os.path.join(root, "gen-7") + "/", "shadow_divergence")
+    assert is_poisoned(root, "gen-7")
+    assert is_poisoned(root, os.path.join(root, "gen-7"))
+    assert load_poison_list(root) == {"gen-7": "shadow_divergence"}
+
+
+# ---------------------------------------------------------------------------
+# Multi-version engine: pins, shadow scoring, promote/rollback
+# ---------------------------------------------------------------------------
+
+
+def _two_version_engine(shadow_fraction=0.0, **cfg):
+    from photon_tpu.serve import ServeConfig, ServingEngine
+
+    m1, m2 = make_model(1.0, seed=1), make_model(3.0, seed=2)
+    defaults = dict(max_batch_size=4, max_delay_ms=1.0, hot_bytes=1 << 30,
+                    max_versions=3, shadow_fraction=shadow_fraction)
+    defaults.update(cfg)
+    eng = ServingEngine(
+        m1, entity_indexes={"userId": make_entity_index()},
+        index_maps=make_index_maps(), config=ServeConfig(**defaults),
+        model_version="v1",
+    )
+    eng.load_version(m2, "v2")
+    return eng, m1, m2
+
+
+def _score_all(eng, xa, xb, n, version=None):
+    return np.asarray([
+        np.float32(eng.score(
+            {"shardA": xa[i], "shardB": xb[i]}, {"userId": f"user{i}"},
+            model_version=version,
+        ))
+        for i in range(n)
+    ])
+
+
+def test_engine_version_pins_are_bit_exact(tmp_path):
+    eng, m1, m2 = _two_version_engine()
+    try:
+        n = 8
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        ref1 = batch_scores(m1, xa, xb, list(range(n)))
+        ref2 = batch_scores(m2, xa, xb, list(range(n)))
+        assert sorted(eng.versions) == ["v1", "v2"]
+        # Unpinned → primary; pinned → that exact version, both bit-exact
+        # with the batch path; the primary never moves.
+        np.testing.assert_array_equal(_score_all(eng, xa, xb, n), ref1)
+        np.testing.assert_array_equal(_score_all(eng, xa, xb, n, "v2"), ref2)
+        assert eng.model_version == "v1"
+        # Unknown pin fails the one request, on the caller's thread.
+        with pytest.raises(ValueError, match="unknown model version"):
+            eng.score({"shardA": xa[0], "shardB": xb[0]},
+                      {"userId": "user0"}, model_version="nope")
+        assert eng.retraces_since_warmup == 0
+    finally:
+        eng.close()
+
+
+def test_engine_shadow_scores_without_touching_responses():
+    eng, m1, m2 = _two_version_engine()
+    try:
+        n = 8
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        ref1 = batch_scores(m1, xa, xb, list(range(n)))
+        ref2 = batch_scores(m2, xa, xb, list(range(n)))
+        eng.start_shadow("v2", fraction=1.0)
+        got = _score_all(eng, xa, xb, n)
+        np.testing.assert_array_equal(got, ref1)  # responses untouched
+        st = eng.shadow_stats()
+        assert st["version"] == "v2" and st["count"] == n
+        samples = eng.shadow_samples()
+        assert len(samples) == n
+        # Shadow scores are bit-exact with a direct pinned-version score,
+        # and the recorded divergence is exactly |shadow - primary|.
+        np.testing.assert_array_equal(
+            np.asarray([np.float32(s["primary"]) for s in samples]), ref1
+        )
+        np.testing.assert_array_equal(
+            np.asarray([np.float32(s["shadow"]) for s in samples]), ref2
+        )
+        for s in samples:
+            assert s["divergence"] == abs(s["shadow"] - s["primary"])
+        eng.stop_shadow()
+        assert eng.shadow_stats()["version"] is None
+        assert eng.retraces_since_warmup == 0
+    finally:
+        eng.close()
+
+
+def test_engine_shadow_fraction_samples_deterministically():
+    eng, _, _ = _two_version_engine()
+    try:
+        n = 16
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        eng.start_shadow("v2", fraction=0.25)
+        _score_all(eng, xa, xb, n)
+        # Fractional accumulator: exactly one in four primary requests is
+        # mirrored — no RNG, so the count is exact, not approximate.
+        assert eng.shadow_stats()["count"] == 4
+    finally:
+        eng.close()
+
+
+def test_engine_shadow_diverge_fault_site():
+    eng, _, _ = _two_version_engine()
+    try:
+        n = 4
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        eng.start_shadow("v2", fraction=1.0)
+        faults.configure(FaultPlan(rules=(
+            FaultRule("serve.shadow_diverge", kind="transient", p=1.0),
+        )))
+        got = _score_all(eng, xa, xb, n)
+        assert np.isfinite(got).all()  # responses still served from primary
+        # The injected +1.0 lands in the divergence record only.
+        assert eng.shadow_stats()["max_divergence"] >= 1.0
+    finally:
+        eng.close()
+
+
+def test_engine_promote_rollback_and_eviction_keeps_parent():
+    eng, m1, m2 = _two_version_engine(max_versions=2)
+    try:
+        n = 6
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        ref1 = batch_scores(m1, xa, xb, list(range(n)))
+        ref2 = batch_scores(m2, xa, xb, list(range(n)))
+
+        out = eng.promote("v2")
+        assert out["parent"] == "v1" and eng.model_version == "v2"
+        np.testing.assert_array_equal(_score_all(eng, xa, xb, n), ref2)
+        assert eng.trips_since_promotion() == 0
+
+        # Loading more versions must never evict the rollback target.
+        eng.load_version(make_model(5.0, seed=5), "v3")
+        eng.load_version(make_model(7.0, seed=7), "v4")
+        assert "v1" in eng.versions and "v2" in eng.versions
+
+        demoted = eng.rollback("test")
+        assert demoted == "v2" and eng.model_version == "v1"
+        np.testing.assert_array_equal(_score_all(eng, xa, xb, n), ref1)
+        # No promotion on record anymore: a second rollback is a no-op.
+        assert eng.rollback("again") is None
+        assert eng.retraces_since_warmup == 0
+        st = eng.stats()
+        assert st["primary"] == "v1" and st["promotion"] is None
+    finally:
+        eng.close()
+
+
+def test_http_model_version_header_pins_scoring():
+    from http.server import ThreadingHTTPServer
+
+    from photon_tpu.cli.game_serving import make_handler
+
+    eng, m1, m2 = _two_version_engine()
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(eng))
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    try:
+        xa = rng.normal(size=D_FIX).astype(np.float32)
+        xb = rng.normal(size=D_RE).astype(np.float32)
+        ref1 = batch_scores(m1, xa[None], xb[None], [3])[0]
+        ref2 = batch_scores(m2, xa[None], xb[None], [3])[0]
+        body = json.dumps({
+            "features": {"shardA": xa.tolist(), "shardB": xb.tolist()},
+            "entityIds": {"userId": "user3"},
+        }).encode()
+
+        def post(headers):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/score", data=body,
+                headers={"Content-Type": "application/json", **headers},
+            )
+            return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+        got = post({})
+        assert np.float32(got["score"]) == ref1
+        assert got["modelVersion"] == "v1"
+        got = post({"X-Model-Version": "v2"})
+        assert np.float32(got["score"]) == ref2
+        assert got["modelVersion"] == "v2"
+        # An unknown pin is this request's 400, not an engine crash.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post({"X-Model-Version": "ghost"})
+        assert err.value.code == 400
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Watcher rollout lifecycle: retry→poison, shadow→promote/abandon, rollback
+# ---------------------------------------------------------------------------
+
+
+def _watched_engine(root, **cfg):
+    from photon_tpu.io.model_io import load_game_model
+    from photon_tpu.serve import ServeConfig, ServingEngine
+
+    imaps = make_index_maps()
+    eidx = make_entity_index()
+    model = load_game_model(os.path.join(root, "gen-1"), imaps,
+                            {"userId": eidx}, to_device=False)
+    defaults = dict(max_batch_size=4, max_delay_ms=1.0, hot_bytes=1 << 30,
+                    max_versions=2)
+    defaults.update(cfg)
+    return ServingEngine(
+        model, entity_indexes={"userId": eidx}, index_maps=imaps,
+        config=ServeConfig(**defaults),
+        model_version=os.path.join(root, "gen-1"),
+    )
+
+
+def _start_watcher(eng, root, opts):
+    from photon_tpu.cli.game_serving import _reload_watcher
+
+    stop = threading.Event()
+    t = threading.Thread(target=_reload_watcher,
+                         args=(eng, root, 0.05, stop, opts), daemon=True)
+    t.start()
+    return stop, t
+
+
+def _await(predicate, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_watcher_retries_then_poisons_unloadable_generation(tmp_path):
+    from photon_tpu.cli.game_serving import RolloutOptions
+    from photon_tpu.io.model_io import is_poisoned, load_poison_list
+
+    root = str(tmp_path)
+    _publish_gen(root, "gen-1", 1.0)
+    eng = _watched_engine(root)
+    opts = RolloutOptions(max_reload_attempts=2, backoff_s=0.01,
+                          backoff_max_s=0.02)
+    stop, t = _start_watcher(eng, root, opts)
+    try:
+        v0 = eng.model_version
+        # Every reload attempt fails at the injected site: after
+        # max_reload_attempts the generation is poisoned, not retried
+        # forever, and the old model keeps serving.
+        faults.configure(FaultPlan(rules=(
+            FaultRule("serve.reload", kind="permanent", p=1.0),
+        )))
+        _publish_gen(root, "gen-2", 3.0)
+        _await(lambda: is_poisoned(root, "gen-2"), msg="gen-2 poisoned")
+        assert eng.model_version == v0
+        assert "reload_failed" in load_poison_list(root)["gen-2"]
+        # Fault cleared: the poison list still blocks re-installation.
+        faults.reset()
+        time.sleep(0.3)
+        assert eng.model_version == v0
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        eng.close()
+
+
+def test_watcher_shadow_quota_then_promote(tmp_path):
+    from photon_tpu.cli.game_serving import RolloutOptions
+
+    root = str(tmp_path)
+    _publish_gen(root, "gen-1", 1.0)
+    eng = _watched_engine(root, shadow_fraction=1.0)
+    opts = RolloutOptions(shadow_fraction=1.0, shadow_quota=4,
+                          divergence_bound=1e9)
+    stop, t = _start_watcher(eng, root, opts)
+    try:
+        m2 = _publish_gen(root, "gen-2", 3.0)
+        _await(lambda: eng.shadow_version is not None,
+               msg="gen-2 installed as shadow")
+        assert eng.model_version.endswith("gen-1")  # still a candidate
+        n = 8
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        _score_all(eng, xa, xb, n)
+        _await(lambda: eng.model_version.endswith("gen-2"),
+               msg="shadow quota promotion")
+        assert eng.shadow_version is None
+        ref2 = batch_scores(m2, xa, xb, list(range(n)))
+        np.testing.assert_array_equal(_score_all(eng, xa, xb, n), ref2)
+        assert eng.retraces_since_warmup == 0
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        eng.close()
+
+
+def test_watcher_divergence_breach_abandons_and_poisons(tmp_path):
+    from photon_tpu.cli.game_serving import RolloutOptions
+    from photon_tpu.io.model_io import is_poisoned, load_poison_list
+
+    root = str(tmp_path)
+    m1 = _publish_gen(root, "gen-1", 1.0)
+    eng = _watched_engine(root, shadow_fraction=1.0)
+    # gen-2 scores genuinely differently (scale 3 vs 1): any mirrored
+    # request blows the tiny divergence bound.
+    opts = RolloutOptions(shadow_fraction=1.0, shadow_quota=1000,
+                          divergence_bound=1e-6)
+    stop, t = _start_watcher(eng, root, opts)
+    try:
+        _publish_gen(root, "gen-2", 3.0)
+        _await(lambda: eng.shadow_version is not None, msg="shadow install")
+        n = 8
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        _score_all(eng, xa, xb, n)
+        _await(lambda: is_poisoned(root, "gen-2"),
+               msg="divergence breach poisons the candidate")
+        assert eng.model_version.endswith("gen-1")
+        assert eng.shadow_version is None
+        assert "shadow_divergence" in load_poison_list(root)["gen-2"]
+        # The abandoned candidate never contaminated live responses.
+        ref1 = batch_scores(m1, xa, xb, list(range(n)))
+        np.testing.assert_array_equal(_score_all(eng, xa, xb, n), ref1)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        eng.close()
+
+
+def test_watcher_breaker_trips_trigger_rollback(tmp_path):
+    from photon_tpu.cli.game_serving import RolloutOptions
+    from photon_tpu.io.model_io import is_poisoned
+
+    root = str(tmp_path)
+    m1 = _publish_gen(root, "gen-1", 1.0)
+    # Short cooldown: the injected failures can also trip gen-1's breaker
+    # (requests race the rollback), and the final parity probe below needs
+    # it closed again.
+    eng = _watched_engine(root, breaker_threshold=2, breaker_cooldown_s=0.2)
+    opts = RolloutOptions(breaker_trip_bound=1, backoff_s=0.01)
+    stop, t = _start_watcher(eng, root, opts)
+    try:
+        _publish_gen(root, "gen-2", 3.0)
+        _await(lambda: eng.model_version.endswith("gen-2"),
+               msg="direct promotion")
+        n = 8
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        # Post-promotion store failures: callers degrade to FE-only (no
+        # errors), the breaker trips, the watcher demotes to the parent.
+        faults.configure(FaultPlan(rules=(
+            FaultRule("serve.store_resolve", kind="transient", p=1.0,
+                      max_count=8),
+        )))
+        got = _score_all(eng, xa, xb, n)
+        assert np.isfinite(got).all()
+        # The poison record is written after the in-engine demotion: await
+        # the durable artifact, which implies the rollback happened.
+        _await(lambda: is_poisoned(root, "gen-2"), msg="rollback + poison")
+        assert eng.model_version.endswith("gen-1")
+
+        # LATEST repointed to the parent: a restart serves gen-1 too.
+        def _latest():
+            with open(os.path.join(root, "LATEST")) as f:
+                return f.read().strip()
+
+        _await(lambda: _latest() == "gen-1", msg="LATEST repointed")
+        faults.reset()
+        time.sleep(0.5)  # poisoned: the watcher must not re-promote gen-2
+        assert eng.model_version.endswith("gen-1")
+        _score_all(eng, xa, xb, n)  # half-open probe closes the breaker
+        ref1 = batch_scores(m1, xa, xb, list(range(n)))
+        np.testing.assert_array_equal(_score_all(eng, xa, xb, n), ref1)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Incremental retraining: merge semantics + end-to-end chain with gate
+# ---------------------------------------------------------------------------
+
+
+def test_merge_random_effect_keeps_unchanged_rows_verbatim():
+    from photon_tpu.train.incremental import (
+        changed_entity_mask,
+        merge_random_effect,
+    )
+
+    E = 6
+    parent = RandomEffectModel(
+        np.arange(E * D_RE, dtype=np.float32).reshape(E, D_RE),
+        "userId", "shardB", TaskType.LOGISTIC_REGRESSION,
+    )
+    trained = RandomEffectModel(
+        -np.ones((E, D_RE), np.float32),
+        "userId", "shardB", TaskType.LOGISTIC_REGRESSION,
+    )
+    users = np.asarray([1, 1, 4], np.int32)
+    batch = GameBatch(
+        label=jnp.zeros(3, jnp.float32), offset=jnp.zeros(3, jnp.float32),
+        weight=jnp.ones(3, jnp.float32),
+        features={"shardB": jnp.zeros((3, D_RE), jnp.float32)},
+        entity_ids={"userId": jnp.asarray(users)},
+    )
+    changed = changed_entity_mask(batch, "userId", E)
+    assert changed.tolist() == [False, True, False, False, True, False]
+    merged = merge_random_effect(parent, trained, changed)
+    coefs = np.asarray(merged.coefficients)
+    p = np.asarray(parent.coefficients)
+    np.testing.assert_array_equal(coefs[[0, 2, 3, 5]], p[[0, 2, 3, 5]])
+    assert (coefs[[1, 4]] == -1.0).all()
+
+    # A feature-dimension change is a hard error, not a silent merge.
+    wider = RandomEffectModel(
+        np.zeros((E, D_RE + 1), np.float32), "userId", "shardB",
+        TaskType.LOGISTIC_REGRESSION,
+    )
+    with pytest.raises(ValueError):
+        merge_random_effect(parent, wider, changed)
+
+
+def _training_fixture(E=16, n=512, d_fix=5, d_re=3, seed=9):
+    r = np.random.default_rng(seed)
+    w_fix = r.normal(size=d_fix).astype(np.float32)
+    w_re = r.normal(scale=1.5, size=(E, d_re)).astype(np.float32)
+
+    def batch(n, entities, seed):
+        rr = np.random.default_rng(seed)
+        Xf = rr.normal(size=(n, d_fix)).astype(np.float32)
+        Xf[:, 0] = 1.0
+        Xr = rr.normal(size=(n, d_re)).astype(np.float32)
+        Xr[:, 0] = 1.0
+        users = rr.choice(np.asarray(entities, np.int32), size=n)
+        logits = Xf @ w_fix + np.sum(Xr * w_re[users], axis=1)
+        y = (rr.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+        return GameBatch(
+            label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+            weight=jnp.ones(n, jnp.float32),
+            features={"global": jnp.asarray(Xf), "per_user": jnp.asarray(Xr)},
+            entity_ids={"userId": jnp.asarray(users)},
+        )
+
+    imaps = {
+        "global": IndexMap.build([f"g{j}" for j in range(d_fix)]),
+        "per_user": IndexMap.build([f"r{j}" for j in range(d_re)]),
+    }
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"user{e}")
+    return batch, imaps, eidx, E, n
+
+
+def _train_configs():
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+
+    return [
+        FixedEffectCoordinateConfig("global", "global"),
+        RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+    ]
+
+
+def test_incremental_update_chain_preserves_unchanged_entities(tmp_path):
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        load_game_model,
+        load_generation_manifest,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.train.incremental import (
+        compute_holdout_metrics,
+        incremental_update,
+    )
+
+    root = str(tmp_path)
+    batch, imaps, eidx, E, _ = _training_fixture()
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    suite = EvaluationSuite([EvaluatorSpec.parse("AUC")],
+                            num_entities={"userId": E})
+    full = batch(512, list(range(E)), seed=11)
+    valid = batch(256, list(range(E)), seed=12)
+
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs=_train_configs(),
+        num_iterations=2, num_entities={"userId": E},
+    )
+    (res,) = est.fit(full, validation_batch=valid, evaluation_suite=suite)
+    g1 = os.path.join(root, "gen-1")
+    save_game_model(res.model, g1, imaps, {"userId": eidx},
+                    sparsity_threshold=0.0)
+    write_generation_manifest(
+        g1, parent=None,
+        holdout_metrics=compute_holdout_metrics(res.model, valid, suite),
+    )
+    assert gate_and_publish(root, "gen-1").ok
+
+    # Only entities 0..3 have fresh data: the rest must ride along verbatim.
+    delta = batch(192, list(range(4)), seed=21)
+    result = incremental_update(
+        root, delta, imaps, {"userId": eidx},
+        TaskType.LOGISTIC_REGRESSION, _train_configs(),
+        ["global", "per_user"], valid_batch=valid, evaluation_suite=suite,
+        num_iterations=2, metric_tolerance=0.1,
+    )
+    assert result.generation == "gen-2"
+    assert result.published, result.gate_reason
+    assert result.changed_entities == {"userId": 4}
+
+    parent = load_game_model(g1, imaps, {"userId": eidx}, to_device=False)
+    child = load_game_model(result.model_dir, imaps, {"userId": eidx},
+                            to_device=False)
+    p_re = np.asarray(parent.models["per_user"].coefficients)
+    c_re = np.asarray(child.models["per_user"].coefficients)
+    np.testing.assert_array_equal(p_re[4:], c_re[4:])
+    assert np.abs(c_re[:4] - p_re[:4]).max() > 0
+    man = load_generation_manifest(result.model_dir)
+    assert man["parent"] == "gen-1"
+    assert man["gate"]["status"] == "published"
+    assert man["changedEntities"] == {"userId": 4}
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == "gen-2"
+
+
+def test_incremental_gate_refuses_injected_corruption(tmp_path):
+    """model.corrupt_manifest and model.bad_holdout both leave the bad
+    generation on disk, unpublished, with the refusal reason recorded —
+    and LATEST never moves."""
+    from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
+    from photon_tpu.io.model_io import load_generation_manifest
+    from photon_tpu.train.incremental import incremental_update
+
+    root = str(tmp_path)
+    batch, imaps, eidx, E, _ = _training_fixture(seed=10)
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    suite = EvaluationSuite([EvaluatorSpec.parse("AUC")],
+                            num_entities={"userId": E})
+    valid = batch(256, list(range(E)), seed=2)
+
+    def update(seed, **kw):
+        return incremental_update(
+            root, batch(256, list(range(E)), seed=seed), imaps,
+            {"userId": eidx}, TaskType.LOGISTIC_REGRESSION,
+            _train_configs(), ["global", "per_user"], valid_batch=valid,
+            evaluation_suite=suite, num_iterations=1, **kw,
+        )
+
+    assert update(1, metric_tolerance=1.0).published  # gen-1 baseline
+
+    faults.configure(FaultPlan(rules=(
+        FaultRule("model.corrupt_manifest", kind="permanent", at=(0,)),
+    )))
+    r = update(3, metric_tolerance=1.0)
+    faults.reset()
+    assert not r.published and "checksum_mismatch" in r.gate_reason
+    assert load_generation_manifest(r.model_dir)["gate"]["status"] == "rejected"
+
+    faults.configure(FaultPlan(rules=(
+        FaultRule("model.bad_holdout", kind="permanent", at=(0,)),
+    )))
+    r = update(4)
+    faults.reset()
+    assert not r.published and "holdout_regression" in r.gate_reason
+
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == "gen-1"
+
+
+def test_quarantined_entity_heals_across_generations(tmp_path):
+    """A DIVERGED entity in generation g (quarantined: warm start kept)
+    re-enters training in g+1 when its data shows up in the delta — and
+    the warm start survives the save → manifest → load round trip."""
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        load_game_model,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.train.incremental import incremental_update
+
+    root = str(tmp_path)
+    batch, imaps, eidx, E, _ = _training_fixture(E=12, seed=13)
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    full = batch(384, list(range(E)), seed=5)
+
+    # Generation 1 trains with the first RE block dispatch poisoned: the
+    # affected entities quarantine and keep their (zero) warm start.
+    faults.configure(FaultPlan(rules=(
+        FaultRule("solve.re_block", kind="nan", at=(0,)),
+    )))
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs=_train_configs(),
+        num_iterations=1, num_entities={"userId": E}, re_active_set=True,
+    )
+    (res,) = est.fit(full)
+    faults.reset()
+
+    coefs1 = np.asarray(res.model.models["per_user"].coefficients)[:E]
+    assert np.isfinite(coefs1).all()
+    quarantined = ~np.any(coefs1 != 0.0, axis=-1)
+    assert quarantined.sum() >= 1  # the poison actually landed
+
+    g1 = os.path.join(root, "gen-1")
+    save_game_model(res.model, g1, imaps, {"userId": eidx},
+                    sparsity_threshold=0.0)
+    write_generation_manifest(g1, parent=None, holdout_metrics={})
+    assert gate_and_publish(root, "gen-1").ok  # zeros are finite: gate passes
+
+    # Warm start survives the manifest round trip: reloaded quarantined
+    # rows are still exactly the warm start.
+    reloaded = load_game_model(g1, imaps, {"userId": eidx}, to_device=False)
+    np.testing.assert_array_equal(
+        np.asarray(reloaded.models["per_user"].coefficients)[:E], coefs1
+    )
+
+    # Generation 2: every entity has fresh data, the fault is gone — the
+    # quarantined entities re-enter the active set and train.
+    result = incremental_update(
+        root, batch(384, list(range(E)), seed=6), imaps, {"userId": eidx},
+        TaskType.LOGISTIC_REGRESSION, _train_configs(),
+        ["global", "per_user"], num_iterations=1,
+    )
+    assert result.published, result.gate_reason
+    assert result.changed_entities == {"userId": E}
+    child = load_game_model(result.model_dir, imaps, {"userId": eidx},
+                            to_device=False)
+    coefs2 = np.asarray(child.models["per_user"].coefficients)[:E]
+    assert np.isfinite(coefs2).all()
+    healed = coefs2[quarantined]
+    assert np.all(np.any(healed != 0.0, axis=-1))  # trained, not stuck
+
+
+# ---------------------------------------------------------------------------
+# Satellites: checkpoint payload digests, pipeline dead-letter sidecar
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_sha256_detects_payload_bitrot(tmp_path):
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    d = str(tmp_path)
+    save_checkpoint(d, {"w": np.arange(8, dtype=np.float32)}, 1)
+    save_checkpoint(d, {"w": np.arange(8, dtype=np.float32) * 2}, 2)
+
+    # Bit-rot the newest step's data block while keeping shape/dtype (and
+    # the zip container) intact — only the payload digest can catch this.
+    path = os.path.join(d, "step_2.npz")
+    z = dict(np.load(path, allow_pickle=False))
+    z["leaf_0"] = z["leaf_0"] + 1.0
+    np.savez(path, **z)
+
+    with pytest.raises(ValueError, match="sha256 mismatch"):
+        load_checkpoint(d, step=2)  # explicit step: surface the corruption
+
+    before = registry().counter("checkpoint_corrupt_skipped_total").value
+    state, step = load_checkpoint(d)  # resumable: skip to the last good step
+    assert step == 1
+    np.testing.assert_array_equal(state["w"], np.arange(8, dtype=np.float32))
+    assert registry().counter(
+        "checkpoint_corrupt_skipped_total"
+    ).value == before + 1
+
+
+def test_pipeline_dead_letter_sidecar_records_dropped_chunks(tmp_path):
+    from photon_tpu.io.pipeline import BatchChunk, RetryPolicy, _run_staged
+    from photon_tpu.train.incremental import read_dead_letters
+    from photon_tpu.utils.timed import PipelineStats
+
+    side = str(tmp_path / "dead-letter.jsonl")
+
+    def poisoned(c):
+        if c.index == 1:
+            raise RuntimeError("poisoned chunk")
+        return c
+
+    chunks = [BatchChunk(np.full((4,), float(i), np.float32), 4, i)
+              for i in range(3)]
+    policy = RetryPolicy(max_retries=0, backoff_s=0.001, skip_budget=1,
+                         dead_letter_path=side)
+    out = list(_run_staged(
+        lambda: iter(chunks), lambda x: 0,
+        [("decode", poisoned, lambda x: 0)],
+        PipelineStats(overlapped=True), 2, True, retry=policy,
+    ))
+    assert [c.index for c in out] == [0, 2]
+
+    records = read_dead_letters([side])
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["stage"] == "decode" and rec["chunk"] == 1 and rec["rows"] == 4
+    assert "RuntimeError" in rec["error"] and rec["ts"] > 0
+    # Missing paths are a no-op, not a crash (driver takes a list of them).
+    assert read_dead_letters([side, str(tmp_path / "absent.jsonl")]) == records
+
+
+def test_pipeline_dead_letter_env_override(tmp_path, monkeypatch):
+    from photon_tpu.io.pipeline import DEAD_LETTER_ENV, default_retry_policy
+
+    monkeypatch.setenv(DEAD_LETTER_ENV, str(tmp_path / "dl.jsonl"))
+    assert default_retry_policy().dead_letter_path == str(tmp_path / "dl.jsonl")
