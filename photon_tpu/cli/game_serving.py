@@ -150,6 +150,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--divergence-bound", type=float, default=1e-3,
                    help="max |shadow - primary| score divergence; a "
                         "candidate breaching it is abandoned and poisoned")
+    p.add_argument("--promotion-settle", type=float, default=300.0,
+                   help="seconds after a promotion before it is considered "
+                        "settled: the rollback parent unpins (becomes "
+                        "evictable) and breaker-trip rollback monitoring for "
+                        "that promotion stops (<= 0 = pin until the next "
+                        "promote/rollback)")
     p.add_argument("--breaker-trip-bound", type=int, default=0,
                    help="circuit-breaker trips since promotion that trigger "
                         "automatic rollback to the parent generation "
@@ -381,6 +387,7 @@ def _serve_config(args) -> ServeConfig:
         admission=_admission_config(args),
         max_versions=args.max_model_versions,
         shadow_fraction=args.shadow_fraction,
+        promotion_settle_s=args.promotion_settle,
     )
 
 

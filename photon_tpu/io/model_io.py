@@ -630,11 +630,23 @@ def load_poison_list(publish_root: str) -> Dict[str, str]:
 
 
 def mark_poisoned(publish_root: str, generation: str, reason: str) -> None:
-    """Durably add ``generation`` to the publish root's poison list."""
+    """Durably add ``generation`` to the publish root's poison list.
+
+    The read-modify-write runs under an exclusive flock on a sidecar lock
+    file: a publish root is shared state (the watcher's rollback path can
+    race the gate/driver, or another server process entirely), and a lost
+    update here would let a bad generation be re-adopted."""
     generation = os.path.basename(generation.rstrip("/"))
-    poisoned = load_poison_list(publish_root)
-    poisoned[generation] = reason
-    _write_json_durable(os.path.join(publish_root, POISON_FILE), poisoned)
+    with open(os.path.join(publish_root, POISON_FILE + ".lock"), "a") as lockf:
+        try:
+            import fcntl
+
+            fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
+        except ImportError:  # non-POSIX: best-effort, single-writer only
+            pass
+        poisoned = load_poison_list(publish_root)
+        poisoned[generation] = reason
+        _write_json_durable(os.path.join(publish_root, POISON_FILE), poisoned)
     logger.warning("generation %s marked POISONED: %s", generation, reason)
 
 

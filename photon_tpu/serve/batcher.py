@@ -58,7 +58,10 @@ class ScoreRequest:
     # Version pin: None scores on the engine's primary generation; a set
     # value is resolved (exact key or basename) against the resident
     # versions at submit time — unknown pins raise there, on the caller's
-    # thread, never inside a batch.
+    # thread, never inside a batch. After scoring the engine overwrites
+    # this with the generation that ACTUALLY produced the score (the
+    # primary for unpinned requests, or on a pin-evicted fallback), so
+    # response labels are always truthful.
     model_version: Optional[str] = None
 
 

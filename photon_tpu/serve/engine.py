@@ -88,6 +88,10 @@ class ServeConfig:
     admission: Optional[AdmissionConfig] = None  # per-tenant quotas/classes
     max_versions: int = 2  # resident generations (primary + candidates)
     shadow_fraction: float = 0.0  # of primary traffic re-scored on shadow
+    # A promotion is "settled" (rollback parent unpinned, breaker-trip
+    # monitoring window closed) this many seconds after promote(). <= 0
+    # keeps the parent pinned until the next promote/rollback.
+    promotion_settle_s: float = 300.0
 
 
 class _Breaker:
@@ -402,7 +406,16 @@ class ServingEngine:
                     # Pinned version evicted between submit and flush (a
                     # promote/evict race): the primary answers rather than
                     # failing the whole batch.
+                    registry().counter("serve_pin_fallback_total").inc()
+                    logger.warning(
+                        "serving: pinned version %r evicted before flush; "
+                        "scoring on primary %r", key, self._primary,
+                    )
                     key = self._primary
+                # Record the generation that ACTUALLY scores this request —
+                # the front ends report req.model_version, and the caller
+                # must never see a pin label a score it didn't produce.
+                r.model_version = key
                 groups.setdefault(key, []).append(i)
             for key, idxs in groups.items():
                 sub = [requests[i] for i in idxs]
@@ -540,12 +553,33 @@ class ServingEngine:
     def _total_trips(self) -> int:
         return sum(b.trips for b in self._breakers.values())
 
-    def _evict_locked(self) -> None:
+    def _maybe_settle_promotion_locked(self) -> None:
+        """Clear ``_promotion`` once its monitoring window has passed:
+        ``promotion_settle_s`` after promote(), the promoted generation is
+        considered adopted — the rollback parent unpins (becomes evictable)
+        and ``trips_since_promotion`` stops counting against it. Without
+        this the parent stays pinned forever and, at the default
+        ``max_versions=2``, the pin set alone fills the residency cap."""
+        promo = self._promotion
+        settle = float(self.config.promotion_settle_s or 0.0)
+        if promo is None or settle <= 0:
+            return
+        if time.time() - promo["at"] >= settle:
+            self._promotion = None
+            logger.info(
+                "serving: promotion of %r settled after %.0fs; parent %r "
+                "no longer pinned", promo["version"], settle, promo["parent"],
+            )
+
+    def _evict_locked(self, protect: Optional[str] = None) -> None:
         """Drop oldest resident generations beyond ``max_versions``. The
-        primary, the shadow, and the current promotion's parent (the
-        rollback target) are never evicted."""
+        primary, the shadow, the current promotion's parent (the rollback
+        target), and ``protect`` (a generation being loaded right now) are
+        never evicted — residency may temporarily exceed the cap rather
+        than drop any of those."""
         cap = max(int(self.config.max_versions), 1)
-        keep = {self._primary, self._shadow}
+        self._maybe_settle_promotion_locked()
+        keep = {self._primary, self._shadow, protect}
         if self._promotion is not None:
             keep.add(self._promotion["parent"])
         for key in list(self._states):
@@ -555,6 +589,12 @@ class ServingEngine:
                 continue
             del self._states[key]
             logger.info("serving: evicted resident generation %r", key)
+        if len(self._states) > cap:
+            logger.warning(
+                "serving: %d generations resident over max_versions=%d "
+                "(primary/shadow/rollback-parent/loading are never evicted)",
+                len(self._states), cap,
+            )
 
     def load_version(
         self, model: GameModel, model_version: Optional[str] = None
@@ -584,7 +624,18 @@ class ServingEngine:
             ) from exc
         with self._lock:
             self._states[new_state.model_version] = new_state
-            self._evict_locked()
+            self._evict_locked(protect=new_state.model_version)
+            resident = new_state.model_version in self._states
+        if not resident:
+            # _evict_locked protects the new generation, so this is a
+            # should-never-happen backstop — but success must only ever be
+            # reported for a generation that is actually resident.
+            self._reload_failures += 1
+            self._last_reload_error = f"{version}: evicted during load"
+            registry().counter("serve_reload_failures_total").inc()
+            raise ReloadError(
+                f"reload to {version!r} failed: evicted during load"
+            )
         self._last_reload_error = None
         registry().counter("serve_model_reloads_total").inc()
         return dict(model_version=version, store=new_state.store.stats())
@@ -660,9 +711,12 @@ class ServingEngine:
 
     def trips_since_promotion(self) -> int:
         """Breaker trips since the last ``promote`` — the watcher's rollback
-        signal. 0 when nothing was promoted."""
-        promo = self._promotion
-        return self._total_trips() - promo["trips_at"] if promo else 0
+        signal. 0 when nothing was promoted, or once the promotion's
+        ``promotion_settle_s`` monitoring window has passed."""
+        with self._lock:
+            self._maybe_settle_promotion_locked()
+            promo = self._promotion
+            return self._total_trips() - promo["trips_at"] if promo else 0
 
     def rollback(self, reason: str = "") -> Optional[str]:
         """Demote the promoted generation back to its parent. Returns the
@@ -701,6 +755,7 @@ class ServingEngine:
         degraded = sorted(
             rt for rt, b in self._breakers.items() if b.open
         )
+        trips = self.trips_since_promotion()  # may settle the promotion
         promo = self._promotion
         return dict(
             model_version=state.model_version,
@@ -709,7 +764,7 @@ class ServingEngine:
             shadow=self._shadow,
             shadow_stats=self.shadow_stats(),
             promotion=dict(promo) if promo else None,
-            trips_since_promotion=self.trips_since_promotion(),
+            trips_since_promotion=trips,
             queue_depth=self.batcher.queue_depth,
             max_batch_size=self.max_batch,
             trace_count=state.transformer.trace_count,

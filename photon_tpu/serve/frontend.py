@@ -323,8 +323,8 @@ class ScorerServer:
                     id=rid, ok=True,
                     result=dict(
                         score=f.result(),
-                        # submit() resolved the pin onto the request; an
-                        # unpinned request scored on the primary.
+                        # The engine records the generation that actually
+                        # scored the request on it at flush time.
                         modelVersion=(
                             req.model_version or self.engine.model_version
                         ),
@@ -521,8 +521,8 @@ class LocalBackend:
             else:
                 dst.set_result(dict(
                     score=f.result(),
-                    # submit() resolved the pin onto the request; an
-                    # unpinned request scored on the primary.
+                    # The engine records the generation that actually
+                    # scored the request on it at flush time.
                     modelVersion=(
                         req.model_version or self.engine.model_version
                     ),

@@ -236,6 +236,29 @@ def test_poison_list_and_generation_names(tmp_path):
     assert load_poison_list(root) == {"gen-7": "shadow_divergence"}
 
 
+def test_mark_poisoned_concurrent_writers_lose_nothing(tmp_path):
+    # The poison list is shared state under a publish root; the sidecar
+    # flock must serialize read-modify-write cycles so concurrent writers
+    # (watcher rollback racing the gate, or multiple servers) never drop
+    # each other's entries.
+    from photon_tpu.io.model_io import load_poison_list, mark_poisoned
+
+    root = str(tmp_path)
+    n = 12
+    threads = [
+        threading.Thread(
+            target=mark_poisoned, args=(root, f"gen-{i}", f"reason-{i}")
+        )
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    got = load_poison_list(root)
+    assert got == {f"gen-{i}": f"reason-{i}" for i in range(n)}
+
+
 # ---------------------------------------------------------------------------
 # Multi-version engine: pins, shadow scoring, promote/rollback
 # ---------------------------------------------------------------------------
@@ -382,6 +405,65 @@ def test_engine_promote_rollback_and_eviction_keeps_parent():
         assert eng.retraces_since_warmup == 0
         st = eng.stats()
         assert st["primary"] == "v1" and st["promotion"] is None
+    finally:
+        eng.close()
+
+
+def test_engine_default_cap_keeps_adopting_after_promotion():
+    # Regression: at the CLI-default max_versions=2, {primary + pinned
+    # rollback parent} equals the cap — a never-settled promotion used to
+    # make _evict_locked drop every newly loaded generation immediately
+    # (load_version "succeeded", then start_shadow/promote raised), so the
+    # rollout stopped adopting anything after the first promotion.
+    eng, _, _ = _two_version_engine(max_versions=2)
+    try:
+        eng.promote("v2")
+        eng.load_version(make_model(5.0, seed=5), "v3")
+        assert "v3" in eng.versions  # never evict the just-loaded generation
+        eng.start_shadow("v3", fraction=1.0)  # must not raise
+        eng.promote("v3")
+        assert eng.model_version == "v3"
+        # The new promotion re-anchored the pin set to {v3, parent v2}:
+        # the old parent v1 is evictable and the next load drops it.
+        eng.load_version(make_model(7.0, seed=7), "v4")
+        assert "v4" in eng.versions and "v1" not in eng.versions
+        assert eng.retraces_since_warmup == 0
+    finally:
+        eng.close()
+
+
+def test_engine_promotion_settles_after_window():
+    eng, _, _ = _two_version_engine(max_versions=2, promotion_settle_s=0.05)
+    try:
+        eng.promote("v2")
+        assert eng.stats()["promotion"] is not None
+        time.sleep(0.1)
+        # Window passed: monitoring stops, the parent pin releases...
+        assert eng.trips_since_promotion() == 0
+        assert eng.stats()["promotion"] is None
+        # ...so the next load evicts the old parent instead of overflowing.
+        eng.load_version(make_model(5.0, seed=5), "v3")
+        assert sorted(eng.versions) == ["v2", "v3"]
+    finally:
+        eng.close()
+
+
+def test_engine_records_actual_scoring_version_on_request():
+    from photon_tpu.serve.batcher import ScoreRequest
+
+    eng, _, _ = _two_version_engine()
+    try:
+        xa = rng.normal(size=D_FIX).astype(np.float32)
+        xb = rng.normal(size=D_RE).astype(np.float32)
+        # Unpinned: the engine stamps the primary that actually scored it.
+        req = ScoreRequest({"shardA": xa, "shardB": xb}, {"userId": "user0"})
+        eng.submit(req).result()
+        assert req.model_version == "v1"
+        # Pinned: the stamp is the resolved pin.
+        req2 = ScoreRequest({"shardA": xa, "shardB": xb}, {"userId": "user0"},
+                            model_version="v2")
+        eng.submit(req2).result()
+        assert req2.model_version == "v2"
     finally:
         eng.close()
 
