@@ -821,6 +821,185 @@ def run_active_set_ab(passes: int = 5):
     )
 
 
+def run_out_of_core_ab(passes: int = 4):
+    """Out-of-core-vs-fully-resident A/B for budgeted random-effect
+    residency (algorithm/re_store.py): the same cohort trained twice — once
+    with every block device-resident, once under a device byte budget of at
+    most a QUARTER of the random-effect footprint, so block data and
+    coefficients ride the staged upload/download pipeline and the LRU
+    evicts in waves. CPU-measurable.
+
+    Acceptance (ISSUE 9): footprint ≥ 4× budget with BIT-identical final
+    coefficients (asserted — objective rel diff ≤ 1e-6 follows trivially),
+    zero post-warmup retraces in the budgeted run (asserted), peak device
+    RE bytes ≤ the budget from the ``re_device_resident_bytes_peak`` gauge
+    (asserted), and the wall-time retention + h2d/d2h overlap telemetry
+    reported for the ≤1.5× throughput bar."""
+    import jax.numpy as jnp
+
+    from photon_tpu.algorithm.random_effect import RandomEffectCoordinate
+    from photon_tpu.algorithm.re_store import block_device_cost
+    from photon_tpu.algorithm.solve_cache import SolveCache
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.factory import OptimizerSpec
+    from photon_tpu.types import OptimizerType, TaskType
+
+    rng = np.random.default_rng(29)
+    E_ab, d_re = 960, 16
+    counts = np.where(
+        rng.uniform(size=E_ab) < 0.5,
+        rng.integers(60, 70, size=E_ab),
+        rng.integers(90, 120, size=E_ab),
+    ).astype(int)
+    users = np.repeat(np.arange(E_ab, dtype=np.int32), counts)
+    n = users.size
+    Xr = rng.normal(size=(n, d_re)).astype(np.float32)
+    truth = rng.normal(size=(E_ab, d_re)).astype(np.float32) * 0.5
+    logits = np.einsum("nd,nd->n", Xr, truth[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    w = np.ones(n, np.float32)
+    batch = GameBatch(
+        label=jnp.asarray(y),
+        offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.asarray(w),
+        features={"re": jnp.asarray(Xr)},
+        entity_ids={"userId": jnp.asarray(users)},
+    )
+    cfg = RandomEffectDataConfig(
+        re_type="userId", feature_shard="re", n_buckets=8,
+        shape_bucketing=True, subspace_projection=False,
+    )
+
+    def _dataset():
+        return build_random_effect_dataset(users, Xr, y, w, E_ab, cfg)
+
+    probe = _dataset().blocks
+    footprint = sum(block_device_cost(b) for b in probe)
+    max_cost = max(block_device_cost(b) for b in probe)
+    budget = footprint // 4
+    # Budget honesty: the store floors its effective budget at the largest
+    # block (refusing it would deadlock), so "peak ≤ configured budget" is
+    # only meaningful when the configured budget clears that floor.
+    assert max_cost <= budget, (
+        f"cohort too lumpy for a 4x A/B: largest block {max_cost} B exceeds "
+        f"quarter-footprint budget {budget} B — rebucket the cohort"
+    )
+
+    def run_variant(device_budget):
+        cache = SolveCache(donate=True)
+        coord = RandomEffectCoordinate(
+            coordinate_id="per_user", dataset=_dataset(),
+            task=TaskType.LOGISTIC_REGRESSION,
+            objective=GLMObjective(loss=LogisticLoss, l2_weight=0.5),
+            optimizer_spec=OptimizerSpec(
+                optimizer=OptimizerType.NEWTON, max_iter=25, tol=1e-9
+            ),
+            solve_cache=cache,
+            device_budget_bytes=device_budget,
+        )
+        model = None
+        walls = []
+        warm_mark = None
+        for it in range(passes):
+            coord.begin_cd_pass(it)
+            t0 = time.perf_counter()
+            model, _stats = coord.train(batch, None, model)
+            coefs = np.asarray(model.coefficients)  # block on device work
+            walls.append(time.perf_counter() - t0)
+            if it == 0:
+                warm_mark = cache.trace_mark()
+        scores = np.asarray(model.score(batch))
+        objective = float(
+            np.mean(w * np.logaddexp(0.0, -(2.0 * y - 1.0) * scores))
+        )
+        return dict(
+            coefs=coefs,
+            objective=objective,
+            walls=walls,
+            traces=cache.stats.traces,
+            post_warm_traces=cache.traces_since(warm_mark),
+            residency=coord.last_residency_stats,
+        )
+
+    _progress("out-of-core A/B: fully-resident variant")
+    full = run_variant(None)
+    _progress(f"out-of-core A/B: budgeted variant ({budget} B, "
+              f"footprint {footprint} B)")
+    ooc = run_variant(budget)
+
+    # The correctness bar: not objective closeness — coefficient EQUALITY.
+    # (Warm starts gather from the frozen previous-pass host table; f32
+    # d2h round-trips are lossless, so any drift is a real bug.)
+    assert np.array_equal(full["coefs"], ooc["coefs"]), (
+        "out-of-core coefficients diverged from the fully-resident run"
+    )
+    rel = abs(ooc["objective"] - full["objective"]) / max(
+        abs(full["objective"]), 1e-30
+    )
+    assert rel <= 1e-6, f"objective parity violated: rel={rel:.3g}"
+    assert ooc["post_warm_traces"] == 0, (
+        f"post-warmup retraces in the budgeted run: {ooc['post_warm_traces']}"
+    )
+    st = ooc["residency"]
+    peak_gauge = registry().find(
+        "re_device_resident_bytes_peak", coordinate="per_user"
+    )
+    assert peak_gauge is not None and peak_gauge.value <= budget, (
+        f"peak device RE bytes {peak_gauge and peak_gauge.value} exceeded "
+        f"the {budget} B budget"
+    )
+    assert st["evictions"] > 0, "quarter budget produced no eviction waves"
+
+    wall_full = float(sum(full["walls"]))
+    wall_ooc = float(sum(ooc["walls"]))
+    # Pass-2+ excludes both variants' compile pass: the steady-state
+    # throughput-retention number.
+    wall_full_p2 = float(sum(full["walls"][1:]))
+    wall_ooc_p2 = float(sum(ooc["walls"][1:]))
+    pipe = st["pipeline"]
+    stages = pipe["stages"]
+    return dict(
+        metric="out_of_core_wall_ratio",
+        value=round(wall_ooc / max(wall_full, 1e-12), 4),
+        unit="ooc_s/full_s",
+        cd_passes=passes,
+        entities=E_ab,
+        footprint_bytes=footprint,
+        budget_bytes=budget,
+        footprint_over_budget=round(footprint / budget, 2),
+        peak_device_bytes=int(peak_gauge.value),
+        evictions=st["evictions"],
+        pass_evictions=st["pass_evictions"],
+        uploads=st["uploads"],
+        upload_hits=st["upload_hits"],
+        upload_bytes=st["upload_bytes"],
+        overlapped_uploads=st["overlapped_uploads"],
+        objective_full=full["objective"],
+        objective_ooc=ooc["objective"],
+        objective_rel_diff=rel,
+        coefficients_bit_identical=True,  # asserted above
+        traces_full=full["traces"],
+        traces_ooc=ooc["traces"],
+        post_warm_traces_ooc=ooc["post_warm_traces"],
+        wall_full_s=[round(t, 4) for t in full["walls"]],
+        wall_ooc_s=[round(t, 4) for t in ooc["walls"]],
+        pass2_plus_wall_ratio=round(
+            wall_ooc_p2 / max(wall_full_p2, 1e-12), 4
+        ),
+        wall_within_1_5x=bool(wall_ooc_p2 <= 1.5 * wall_full_p2),
+        h2d_busy_s=round(stages["h2d"]["busy_s"], 4),
+        d2h_busy_s=round(stages["d2h"]["busy_s"], 4),
+        pipeline_overlap_factor=pipe["overlap_factor"],
+    )
+
+
 def run_pipeline_ab(n_rows: int = 1 << 16, d: int = 48, nnz: int = 12):
     """Overlapped-vs-serial A/B for the staged ingest pipeline
     (io/pipeline.py): decode → assemble → h2d on worker threads with
@@ -2318,6 +2497,12 @@ def main():
         # Gated-vs-full active-set CD passes: objective parity (asserted),
         # skip counts, trace parity, pass-2+ RE wall; CPU-measurable.
         print(json.dumps(run_active_set_ab()))
+        return
+    if "--out-of-core-ab" in sys.argv:
+        # Budgeted-residency vs fully-resident RE training: bit-identical
+        # coefficients (asserted), zero post-warmup retraces, peak device
+        # bytes ≤ budget, wall retention + h2d/d2h overlap; CPU-measurable.
+        print(json.dumps(run_out_of_core_ab()))
         return
     if "--pipeline-ab" in sys.argv:
         # Overlapped-vs-serial ingest pipeline + workers/depth sweep +

@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|serve|faults|soak|rollout|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|rollout|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -197,6 +197,87 @@ skipped = [s["entities_skipped"] for s in stats]
 assert skipped[0] == 0 and all(s > 0 for s in skipped[1:]), skipped
 print(f"   objective {obj_g:.6f} (rel {rel:.1e}), traces {traces_g}, "
       f"skipped/pass {skipped} OK")
+EOF
+}
+
+run_ooc() {
+    # Out-of-core residency smoke: the same RE coordinate trained twice —
+    # fully resident and under a quarter-footprint device budget — must
+    # produce BIT-identical coefficients (objective rel ≤ 1e-6 follows),
+    # see at least 2 eviction waves, and compile nothing after the warm-up
+    # pass. Timing is NOT asserted here; bench.py --out-of-core-ab
+    # measures the throughput-retention and overlap side.
+    echo "== ooc: quarter-budget residency parity smoke =="
+    python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+
+from photon_tpu.algorithm.random_effect import RandomEffectCoordinate
+from photon_tpu.algorithm.re_store import block_device_cost
+from photon_tpu.algorithm.solve_cache import SolveCache
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.random_effect import (
+    RandomEffectDataConfig, build_random_effect_dataset,
+)
+from photon_tpu.ops.losses import LogisticLoss
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optim.factory import OptimizerSpec
+from photon_tpu.types import OptimizerType, TaskType
+
+rng = np.random.default_rng(7)
+E, d_re = 96, 6
+counts = rng.integers(37, 47, size=E)
+eids = np.repeat(np.arange(E, dtype=np.int32), counts)
+n = eids.size
+Xr = rng.normal(size=(n, d_re)).astype(np.float32)
+y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+w = np.ones(n, np.float32)
+batch = GameBatch(
+    label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+    weight=jnp.asarray(w), features={"re": jnp.asarray(Xr)},
+    entity_ids={"userId": jnp.asarray(eids)},
+)
+cfg = RandomEffectDataConfig(re_type="userId", feature_shard="re",
+                             n_buckets=4, shape_bucketing=True,
+                             subspace_projection=False)
+
+def run(budget, passes=4):
+    cache = SolveCache(donate=True)
+    coord = RandomEffectCoordinate(
+        coordinate_id="per_user",
+        dataset=build_random_effect_dataset(eids, Xr, y, w, E, cfg),
+        task=TaskType.LOGISTIC_REGRESSION,
+        objective=GLMObjective(loss=LogisticLoss, l2_weight=0.5),
+        optimizer_spec=OptimizerSpec(optimizer=OptimizerType.NEWTON,
+                                     max_iter=25, tol=1e-9),
+        solve_cache=cache, device_budget_bytes=budget,
+    )
+    model, warm_mark = None, None
+    for it in range(passes):
+        coord.begin_cd_pass(it)
+        model, _ = coord.train(batch, None, model)
+        if it == 0:
+            warm_mark = cache.trace_mark()
+    return model, coord, cache.traces_since(warm_mark)
+
+footprint = sum(block_device_cost(b) for b in
+                build_random_effect_dataset(eids, Xr, y, w, E, cfg).blocks)
+ref, _, ref_post = run(None)
+ooc, coord, ooc_post = run(footprint // 4)
+st = coord.last_residency_stats
+assert np.array_equal(np.asarray(ref.coefficients),
+                      np.asarray(ooc.coefficients)), "coefficients diverged"
+s_ref, s_ooc = np.asarray(ref.score(batch)), np.asarray(ooc.score(batch))
+obj = lambda s: float(np.mean(w * np.logaddexp(0.0, -(2 * y - 1) * s)))
+rel = abs(obj(s_ooc) - obj(s_ref)) / max(abs(obj(s_ref)), 1e-30)
+assert rel <= 1e-6, f"objective parity violated: rel={rel:.3g}"
+waves = sum(1 for e in st["pass_evictions"] if e > 0)
+assert waves >= 2, f"expected >=2 eviction waves, got {st['pass_evictions']}"
+assert ooc_post == 0, f"post-warmup retraces: {ooc_post}"
+assert st["peak_bytes"] <= st["effective_budget_bytes"], st
+print(f"   footprint {footprint} B @ budget {footprint // 4} B: "
+      f"bit-identical coefs, rel {rel:.1e}, evictions/pass "
+      f"{st['pass_evictions']}, post-warmup traces {ooc_post} OK")
 EOF
 }
 
@@ -455,12 +536,13 @@ case "$stage" in
     dryrun) run_dryrun ;;
     telemetry) run_telemetry ;;
     active-set) run_active_set ;;
+    ooc) run_ooc ;;
     serve) run_serve ;;
     faults) run_faults ;;
     soak) run_soak ;;
     rollout) run_rollout ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_serve; run_faults; run_soak; run_rollout; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_rollout; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

@@ -291,6 +291,12 @@ class CoordinateDescent:
                             active_set=getattr(
                                 coord, "last_active_set_stats", None
                             ),
+                            # Out-of-core residency accounting (host ints the
+                            # coordinate's store tracked during the pass) —
+                            # None for fully-resident coordinates.
+                            residency=getattr(
+                                coord, "last_residency_stats", None
+                            ),
                         )
                     )
 

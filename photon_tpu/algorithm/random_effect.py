@@ -20,6 +20,7 @@ exactly like the reference's tracker.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -301,6 +302,12 @@ class RandomEffectCoordinate(Coordinate):
     # everything else preserves the sync-free dispatch invariant.
     active_set: bool = False
     convergence_tol: float = 1e-4
+    # Out-of-core residency: with a byte budget, block data lives in a host
+    # master (optionally memory-mapped under ``device_spill_dir``) and only
+    # a budgeted working set is device-resident, managed by
+    # algorithm/re_store.ReDeviceStore. None → fully resident (default).
+    device_budget_bytes: Optional[int] = None
+    device_spill_dir: Optional[str] = None
 
     def __post_init__(self):
         self.compute_variance = normalize_variance_type(self.compute_variance)
@@ -310,6 +317,43 @@ class RandomEffectCoordinate(Coordinate):
         self._config = dataclasses.replace(
             self.optimizer_spec.config(), track_history=False
         )
+        self._store = None
+        self.last_residency_stats: Optional[dict] = None
+        if self.device_budget_bytes:
+            if self.dataset.projected:
+                import logging
+
+                logging.getLogger("photon_tpu").warning(
+                    "coordinate %s: out-of-core residency supports dense RE "
+                    "datasets only (projected blocks keep content-defined "
+                    "col_map widths); training fully resident",
+                    self.coordinate_id,
+                )
+            elif self.dataset.config.features_to_samples_ratio is not None:
+                raise ValueError(
+                    "out-of-core residency is incompatible with "
+                    "features_to_samples_ratio (Pearson masks pin every "
+                    "block on device at construction)"
+                )
+            elif self.compute_variance != VarianceComputationType.NONE:
+                raise ValueError(
+                    "out-of-core residency does not support coefficient "
+                    "variance computation (the variance pass re-reads every "
+                    "block outside the residency budget)"
+                )
+            else:
+                from photon_tpu.algorithm.re_store import ReDeviceStore
+
+                self._store = ReDeviceStore(
+                    self.dataset.blocks,
+                    self.device_budget_bytes,
+                    self.coordinate_id,
+                    self.device_spill_dir,
+                )
+                # Drop the device references: from here on the dataset's
+                # blocks ARE the host master, and device placement happens
+                # only through the store's budgeted upload stage.
+                self.dataset.blocks = self._store.blocks
         self._feature_masks: Dict[int, Array] = {}
         ratio = self.dataset.config.features_to_samples_ratio
         if ratio is not None:
@@ -426,9 +470,13 @@ class RandomEffectCoordinate(Coordinate):
         """Pass-boundary hook, called by CoordinateDescent before this
         coordinate's update: a descent restarting at iteration 0 begins with
         a full (ungated) pass, discarding any mask state left over from a
-        previous run of the same coordinate object."""
+        previous run of the same coordinate object. With an out-of-core
+        store, this is also the residency epoch boundary (per-pass eviction
+        accounting resets; resident blocks stay warm across passes)."""
         if cd_iteration == 0:
             self._reset_active_set()
+        if self._store is not None:
+            self._store.begin_pass(cd_iteration)
 
     def export_active_state(self) -> Optional[dict]:
         """Checkpointable snapshot of the active-set gate: the CD pass
@@ -527,7 +575,9 @@ class RandomEffectCoordinate(Coordinate):
             np.where(valid, np.arange(b.num_entities), -1).astype(np.int32),
         )
 
-    def _dense_dispatch_entries(self, keep: List[np.ndarray]) -> list:
+    def _dense_dispatch_entries(
+        self, keep: List[np.ndarray], to_device: bool = True
+    ) -> list:
         """Dispatch plan for a gated dense pass: group same-geometry blocks,
         pool their still-active rows, and repack them onto entity
         allocations the first full pass already compiled (zero new retraces
@@ -555,7 +605,7 @@ class RandomEffectCoordinate(Coordinate):
             obj = self._block_objectives[idxs[0]]
             idx_arr = np.asarray(idxs, np.int32)
             for block_c, sb_local, sr in compact_entity_blocks(
-                members, keeps, allowed
+                members, keeps, allowed, to_device=to_device
             ):
                 sb = np.where(
                     sb_local >= 0, idx_arr[np.maximum(sb_local, 0)], -1
@@ -611,6 +661,8 @@ class RandomEffectCoordinate(Coordinate):
             total_offset = total_offset + residual_scores
         if self.dataset.projected:
             return self._train_projected(total_offset, initial_model)
+        if self._store is not None:
+            return self._train_dense_ooc(batch, total_offset, initial_model)
         return self._train_dense(batch, total_offset, initial_model)
 
     def _train_dense(
@@ -722,6 +774,194 @@ class RandomEffectCoordinate(Coordinate):
         if block.dim > d:
             w0 = jnp.pad(w0, ((0, 0), (0, block.dim - d)))
         return w0
+
+    def _train_dense_ooc(
+        self, batch: GameBatch, total_offset: Array, initial_model
+    ) -> Tuple[RandomEffectModel, RandomEffectTrackerStats]:
+        """Out-of-core dense pass: host master coefficients and block data,
+        device working set under the store's byte budget, traffic on the
+        ingest pipeline machinery (h2d upload stage ahead of the dispatch
+        loop, d2h download worker behind it, both bounded).
+
+        Parity with :meth:`_train_dense` is BIT-EXACT by construction:
+
+        * Every warm start gathers from ``coefs_prev`` — a host copy of the
+          previous pass's coefficients, frozen at pass start. The resident
+          path reads the same values: its scatters all land after every
+          dispatch, so no solve ever observes another solve's update within
+          a pass.
+        * An uploaded block is a bit-identical copy of the resident path's
+          block (same arrays, same bucket geometry) and therefore runs the
+          SAME cached executable — zero retraces across evictions.
+        * Results round-trip d2h losslessly (f32 copies, no arithmetic) and
+          scatter into disjoint rows of ``coefs_out`` — order-independent,
+          so download order cannot perturb values.
+
+        The returned model carries HOST numpy coefficients (the master
+        table); scoring gathers rows through them on demand, producing the
+        same device values as a resident model.
+        """
+        from photon_tpu.algorithm.re_store import block_data_bytes
+        from photon_tpu.io.pipeline import (
+            DEFAULT_QUEUE_DEPTH,
+            StageWorker,
+            _finalize_pipeline_telemetry,
+            _run_staged,
+        )
+        from photon_tpu.utils.timed import PipelineStats, record_pipeline
+
+        store = self._store
+        E, d = self.dataset.num_entities, self.dataset.dim
+        if isinstance(initial_model, ProjectedRandomEffectModel):
+            initial_model = initial_model.to_dense()
+        coefs_prev = (
+            np.asarray(initial_model.coefficients, np.float32)
+            if initial_model is not None
+            else np.zeros((E, d), np.float32)
+        )
+        coefs_out = coefs_prev.copy()
+        gated = (
+            self.active_set
+            and self._pending_masks is not None
+            and initial_model is not None
+        )
+        store.begin_pass(self._cd_pass)
+        if gated:
+            keep = self._fetch_active_masks()
+            # The residency policy IS the active set: blocks whose entities
+            # all converged are evicted right here, at the pass-boundary
+            # sync the mask fetch already paid for.
+            store.retire(
+                [
+                    i
+                    for i, k in enumerate(keep)
+                    if self._block_valid_counts[i] and not k.any()
+                ]
+            )
+            with span("re_compact"):
+                entries = self._dense_dispatch_entries(keep, to_device=False)
+        else:
+            entries = [
+                self._identity_entry(i)
+                for i in range(len(self.dataset.blocks))
+            ]
+        tol = self.convergence_tol if self.active_set else None
+
+        # Residency keys: original blocks cache across passes under their
+        # dataset index; compacted blocks are transient (their geometry
+        # depends on this pass's active set — an entry could never hit) and
+        # are released as soon as their results download.
+        block_ids = {id(b): i for i, b in enumerate(self.dataset.blocks)}
+        plan = []
+        for j, entry in enumerate(entries):
+            key = block_ids.get(id(entry[0]), ("compact", self._cd_pass, j))
+            plan.append((key, entry))
+
+        def upload(item):
+            key, (block, obj, mask, sb, sr) = item
+            eidx = np.asarray(block.entity_idx)
+            w0 = coefs_prev[np.maximum(eidx, 0)]
+            if block.dim > d:
+                w0 = np.pad(w0, ((0, 0), (0, block.dim - d)))
+            cacheable = isinstance(key, int)
+            dev_block, w0_dev = store.acquire(key, block, w0, cacheable)
+            return (
+                block_data_bytes(block), key, cacheable, dev_block, obj,
+                mask, sb, sr, eidx, w0_dev,
+            )
+
+        results_host: list = []
+        pending_host: list = []
+
+        def download(item):
+            key, cacheable, sb, sr, eidx, out = item
+            if tol is not None:
+                w, iters, reasons, act, quar = out
+            else:
+                w, iters, reasons = out
+            w_host = np.asarray(w)  # blocks until the device solve completes
+            valid = eidx >= 0
+            coefs_out[eidx[valid]] = w_host[valid, :d]
+            results_host.append((eidx, np.asarray(iters), np.asarray(reasons)))
+            if tol is not None:
+                pending_host.append((np.asarray(act), np.asarray(quar), sb, sr))
+            store.mark_solve_done()
+            store.release(key, cacheable)
+
+        label = f"re_store/{self.coordinate_id}"
+        stats = PipelineStats(overlapped=True)
+        record_pipeline(label, stats)
+        solve_stage = stats.stage("solve")
+        worker = StageWorker(
+            "d2h", download, stats.stage("d2h"), depth=DEFAULT_QUEUE_DEPTH,
+            nbytes_of=lambda item, _res: 4 * int(np.prod(item[5][0].shape)),
+        )
+        gen = _run_staged(
+            lambda: iter(plan),
+            lambda item: 0,
+            [("h2d", upload, lambda out: out[0])],
+            stats,
+            depth=DEFAULT_QUEUE_DEPTH,
+            overlap=True,
+            source_name="plan",
+        )
+        t0_wall = time.perf_counter()
+        try:
+            with span("re_dispatch_blocks"):
+                for (_nb, key, cacheable, dev_block, obj, mask, sb, sr,
+                     eidx, w0_dev) in gen:
+                    t0 = time.perf_counter()
+                    offs = faults.poison(
+                        "solve.re_block", dev_block.gather_offsets(total_offset)
+                    )
+                    solver = self.solve_cache.block_solver(
+                        obj, self.optimizer_spec, self._config,
+                        has_mask=mask is not None, convergence_tol=tol,
+                    )
+                    store.mark_solve_start()
+                    if gated and self.solve_cache.max_entries is None:
+                        with self.solve_cache.expect_cached(
+                            f"out-of-core dispatch "
+                            f"{tuple(dev_block.features.shape)}"
+                        ):
+                            out = solver(dev_block, offs, w0_dev, mask)
+                    else:
+                        out = solver(dev_block, offs, w0_dev, mask)
+                    solve_stage.add_busy(time.perf_counter() - t0, 0)
+                    worker.submit((key, cacheable, sb, sr, eidx, out))
+            worker.close()
+        except BaseException:
+            store.abort_pass()
+            worker.abort()
+            raise
+        finally:
+            close = getattr(gen, "close", None)
+            if close is not None:
+                close()
+            stats.wall_s = time.perf_counter() - t0_wall
+            _finalize_pipeline_telemetry(label, stats)
+            store.end_pass()
+
+        if tol is not None:
+            self._pending_masks = pending_host
+        self._publish_active_set_stats(
+            gated,
+            dispatched_valid=int(
+                sum(int(np.sum(sb >= 0)) for *_x, sb, _sr in entries)
+            ),
+            dispatched_alloc=int(sum(e[0].num_entities for e in entries)),
+            num_dispatches=len(entries),
+        )
+        self._cd_pass += 1
+        self.last_residency_stats = dict(
+            store.stats(), pipeline=stats.summary()
+        )
+
+        model = RandomEffectModel(
+            coefs_out, self.dataset.config.re_type,
+            self.dataset.config.feature_shard, self.task, None,
+        )
+        return model, self._tracker_stats(results_host)
 
     def _train_projected(
         self, total_offset: Array, initial_model

@@ -1,0 +1,315 @@
+"""Out-of-core random-effect training store: host master, device working set.
+
+The trainer previously required every entity block — coefficients AND
+training data — device-resident for the whole run, capping model size at
+HBM. This module is the training-side twin of the serving hot/cold store
+(serve/store.py): the full dataset lives in host memory (optionally
+memory-mapped from disk via ``spill_dir``), and a byte-budgeted
+working set of device blocks is managed by the shared residency core
+(data/residency.py ``ByteBudgetLru`` — Snap ML's hierarchical out-of-core
+scheme from PAPERS.md, with active-set gating reinterpreted as the
+residency policy: converged entities are precisely the ones safe to evict).
+
+Traffic rides the ingest pipeline machinery (io/pipeline.py): the h2d
+upload stage runs on a ``_run_staged`` worker thread ahead of the dispatch
+loop, and the d2h download stage drains solver results on a
+``StageWorker`` behind it — both with bounded queues, so device residency
+is capped by budget + queue depth, and uploads overlap device compute
+(JAX async dispatch keeps the device busy while the upload thread blocks
+in ``device_put``).
+
+Invariants this store must preserve (the hard part of the design):
+
+* **Zero retraces across evictions** — a re-uploaded block has bit-identical
+  shapes/dtypes to its first upload (same bucket-grid geometry), so the
+  solve cache hits its compiled executable. Residency changes WHERE a block
+  lives, never its aval.
+* **Deterministic eviction sequence** — a single upload thread walks the
+  dispatch plan in order and releases happen in FIFO dispatch order, so the
+  ``ByteBudgetLru`` sees the same call sequence every run (same seed +
+  budget ⇒ identical ``eviction_log``).
+* **Budget honesty** — a block's cost counts its data arrays plus the
+  warm-start and result coefficient buffers that coexist with it in flight;
+  the ``re_device_resident_bytes`` gauge tracks admitted cost and its peak
+  must stay ≤ the effective budget (budget is floored at the single largest
+  block, with a warning, because refusing the largest block would deadlock).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Hashable, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.data.random_effect import EntityBlock
+from photon_tpu.data.residency import ByteBudgetLru
+from photon_tpu.obs.metrics import registry
+
+logger = logging.getLogger("photon_tpu")
+
+_BLOCK_FIELDS = (
+    "entity_idx",
+    "features",
+    "label",
+    "weight",
+    "sample_index",
+    "train_mask",
+)
+
+
+def host_entity_block(
+    block: EntityBlock, spill_dir: Optional[str] = None, index: int = 0
+) -> EntityBlock:
+    """Rebuild ``block`` with host-numpy leaves (dense blocks only).
+
+    With ``spill_dir``, each array round-trips through an ``.npy`` file and
+    comes back memory-mapped read-only — the host master then costs file
+    cache, not RSS, and the upload stage's gathers fault in only the pages
+    it ships."""
+    if block.col_map is not None:
+        raise ValueError("out-of-core residency supports dense blocks only")
+    fields = {}
+    for name in _BLOCK_FIELDS:
+        arr = np.asarray(getattr(block, name))
+        if spill_dir is not None:
+            path = os.path.join(spill_dir, f"block{index:05d}_{name}.npy")
+            np.save(path, arr)
+            arr = np.load(path, mmap_mode="r")
+        fields[name] = arr
+    return EntityBlock(col_map=None, **fields)
+
+
+def block_data_bytes(block: EntityBlock) -> int:
+    """Host bytes of a block's data arrays."""
+    return int(
+        sum(np.asarray(getattr(block, f)).nbytes for f in _BLOCK_FIELDS)
+    )
+
+
+def block_device_cost(block: EntityBlock) -> int:
+    """Budgeted device cost of holding ``block`` in flight: its data arrays
+    plus the warm-start w0 and the solver's result coefficients, both
+    (E, dim) f32 — they coexist with the block between upload and
+    download."""
+    coef_bytes = 2 * block.num_entities * block.dim * 4
+    return block_data_bytes(block) + coef_bytes
+
+
+class ReDeviceStore:
+    """Residency manager for one coordinate's entity blocks.
+
+    Keys are block indices into the coordinate's dataset (cacheable across
+    passes — a resident block is a free upload next pass) or transient
+    tuples for gated-pass compacted blocks (always discarded at release;
+    their geometry depends on the pass's active set, so caching them would
+    never hit).
+
+    Thread contract: ``acquire`` runs on the h2d stage thread, ``release``
+    on the d2h worker thread, ``retire``/``begin_pass``/``end_pass`` on the
+    training thread between passes. All state is serialized under one
+    condition variable, which doubles as the budget backpressure signal —
+    ``acquire`` sleeps until enough protected (in-flight) bytes release.
+    """
+
+    def __init__(
+        self,
+        blocks: Sequence[EntityBlock],
+        budget_bytes: int,
+        coordinate_id: str,
+        spill_dir: Optional[str] = None,
+    ):
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+        self.coordinate_id = coordinate_id
+        self.blocks: List[EntityBlock] = [
+            host_entity_block(b, spill_dir, i) for i, b in enumerate(blocks)
+        ]
+        self.block_cost = [block_device_cost(b) for b in self.blocks]
+        self.total_cost = int(sum(self.block_cost))
+        self.budget = int(budget_bytes)
+        max_cost = max(self.block_cost, default=0)
+        self.effective_budget = max(self.budget, max_cost)
+        if self.effective_budget > self.budget:
+            logger.warning(
+                "re_store[%s]: budget %d B below largest block %d B; "
+                "flooring effective budget there",
+                coordinate_id,
+                self.budget,
+                max_cost,
+            )
+        self.lru = ByteBudgetLru(self.effective_budget, on_evict=self._on_evict)
+        self._resident: Dict[Hashable, EntityBlock] = {}
+        self._protected: set = set()
+        self._cond = threading.Condition()
+        self._abort = False
+        self._inflight_solves = 0
+        # Cumulative traffic counters (mirrored into obs metrics).
+        self.uploads = 0
+        self.upload_hits = 0
+        self.overlapped_uploads = 0
+        self.upload_bytes = 0
+        self.pass_evictions: List[int] = []
+        self._pass_eviction_mark = 0
+        self._labels = dict(coordinate=coordinate_id)
+        self._publish()
+
+    # ------------------------------------------------------------------
+    # Pass lifecycle (training thread).
+    # ------------------------------------------------------------------
+
+    def begin_pass(self, cd_iteration: int) -> None:
+        with self._cond:
+            self._abort = False
+            self._pass_eviction_mark = self.lru.evictions
+        self._publish()
+
+    def end_pass(self) -> None:
+        with self._cond:
+            self.pass_evictions.append(
+                self.lru.evictions - self._pass_eviction_mark
+            )
+        self._publish()
+
+    def abort_pass(self) -> None:
+        """Unstick a blocked upload thread on the error path."""
+        with self._cond:
+            self._abort = True
+            self._cond.notify_all()
+
+    def retire(self, keys: Sequence[Hashable]) -> int:
+        """Active-set residency hook: eagerly evict blocks whose entities
+        all converged (called at the pass-boundary mask fetch — the
+        already-paid sync point). Returns how many were resident."""
+        dropped = 0
+        with self._cond:
+            for key in keys:
+                if key in self._protected:
+                    continue
+                if self.lru.evict(key):
+                    self._resident.pop(key, None)
+                    dropped += 1
+            if dropped:
+                self._cond.notify_all()
+        if dropped:
+            registry().counter(
+                "re_store_retired_total", **self._labels
+            ).inc(dropped)
+            self._publish()
+        return dropped
+
+    # ------------------------------------------------------------------
+    # Upload / download (pipeline stage threads).
+    # ------------------------------------------------------------------
+
+    def acquire(self, key, host_block: EntityBlock, w0_host, cacheable: bool):
+        """h2d stage: make ``key`` resident under the budget (blocking on
+        in-flight releases when needed) and return ``(device_block, w0)``.
+        ``w0`` is always a fresh device buffer — the solver donates it."""
+        import jax
+
+        cost = (
+            self.block_cost[key]
+            if isinstance(key, int)
+            else block_device_cost(host_block)
+        )
+        with self._cond:
+            while True:
+                if self._abort:
+                    raise RuntimeError(
+                        f"re_store[{self.coordinate_id}]: pass aborted"
+                    )
+                if key in self.lru:
+                    self.lru.touch(key)
+                    break
+                if self.lru.would_fit(cost, self._protected):
+                    for victim in self.lru.admit(key, cost, self._protected):
+                        self._resident.pop(victim, None)
+                    break
+                self._cond.wait(0.05)
+            self._protected.add(key)
+            overlapped = self._inflight_solves > 0
+        reg = registry()
+        dev_block = self._resident.get(key)
+        if dev_block is not None:
+            self.upload_hits += 1
+            reg.counter("re_store_upload_hits_total", **self._labels).inc()
+        else:
+            dev_block = jax.device_put(host_block)
+            nbytes = block_data_bytes(host_block)
+            self.uploads += 1
+            self.upload_bytes += nbytes
+            if overlapped:
+                self.overlapped_uploads += 1
+            reg.counter("re_store_uploads_total", **self._labels).inc()
+            reg.counter("re_store_upload_bytes_total", **self._labels).inc(
+                nbytes
+            )
+            if cacheable:
+                with self._cond:
+                    self._resident[key] = dev_block
+        w0 = jax.device_put(np.ascontiguousarray(w0_host))
+        self._publish()
+        return dev_block, w0
+
+    def release(self, key, cacheable: bool) -> None:
+        """d2h worker: the solve's results are materialized on host; the
+        block's in-flight protection (and, for transient compacted blocks,
+        its residency) can go."""
+        with self._cond:
+            self._protected.discard(key)
+            if not cacheable:
+                self.lru.discard(key)
+                self._resident.pop(key, None)
+            self._cond.notify_all()
+        self._publish()
+
+    def mark_solve_start(self) -> None:
+        with self._cond:
+            self._inflight_solves += 1
+
+    def mark_solve_done(self) -> None:
+        with self._cond:
+            self._inflight_solves -= 1
+
+    # ------------------------------------------------------------------
+    # Introspection.
+    # ------------------------------------------------------------------
+
+    def stats(self) -> Dict:
+        return dict(
+            coordinate=self.coordinate_id,
+            budget_bytes=self.budget,
+            effective_budget_bytes=self.effective_budget,
+            footprint_bytes=self.total_cost,
+            resident_bytes=self.lru.resident_bytes,
+            peak_bytes=self.lru.peak_bytes,
+            resident_blocks=len(self.lru),
+            evictions=self.lru.evictions,
+            eviction_log=list(self.lru.eviction_log),
+            uploads=self.uploads,
+            upload_hits=self.upload_hits,
+            overlapped_uploads=self.overlapped_uploads,
+            upload_bytes=self.upload_bytes,
+            pass_evictions=list(self.pass_evictions),
+        )
+
+    def _on_evict(self, key) -> None:
+        registry().counter("re_store_evictions_total", **self._labels).inc()
+
+    def _publish(self) -> None:
+        reg = registry()
+        reg.gauge("re_device_resident_bytes", **self._labels).set(
+            self.lru.resident_bytes
+        )
+        reg.gauge("re_device_resident_bytes_peak", **self._labels).set(
+            self.lru.peak_bytes
+        )
+        reg.gauge("re_device_resident_blocks", **self._labels).set(
+            len(self.lru)
+        )
+        reg.gauge("re_device_budget_bytes", **self._labels).set(
+            self.effective_budget
+        )

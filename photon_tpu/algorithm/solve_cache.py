@@ -373,6 +373,18 @@ class SolveCache:
                 f"{self.stats.trace_keys[nkeys:]}"
             )
 
+    def trace_mark(self) -> int:
+        """Snapshot of the cumulative trace count, for retrace-delta
+        assertions across a window (the out-of-core bench and ci stages
+        assert ``traces_since(mark) == 0`` after warm-up: residency changes
+        where a block lives, never its aval, so evictions must not
+        recompile)."""
+        return int(self.stats.traces)
+
+    def traces_since(self, mark: int) -> int:
+        """New executables traced since :meth:`trace_mark`."""
+        return int(self.stats.traces) - int(mark)
+
     @property
     def num_entries(self) -> int:
         return len(self._fns)

@@ -180,6 +180,29 @@ def add_active_set_args(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_out_of_core_args(p: argparse.ArgumentParser) -> None:
+    """Out-of-core random-effect residency flags shared by all drivers.
+
+    Only the GAME drivers act on them (random-effect coordinates); the
+    fixed-effect-only driver accepts them for CLI-surface parity and warns
+    that they are no-ops there.
+    """
+    p.add_argument(
+        "--re-device-budget-mb", type=float, default=None,
+        help="device byte budget for random-effect block data + "
+             "coefficients; when set, blocks live in a host master "
+             "(optionally memory-mapped, see --re-spill-dir) and only a "
+             "budgeted working set is device-resident — trains models "
+             "bigger than device memory at bit-exact parity",
+    )
+    p.add_argument(
+        "--re-spill-dir", default=None,
+        help="directory for the host master's memory-mapped .npy spill "
+             "(default: host RAM); only meaningful with "
+             "--re-device-budget-mb",
+    )
+
+
 def add_validation_arg(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=["VALIDATE_FULL", "VALIDATE_SAMPLE", "VALIDATE_DISABLED"],

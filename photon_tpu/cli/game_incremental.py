@@ -79,6 +79,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gate: max relative L2 coefficient-norm drift per "
                         "coordinate vs the parent")
     p.add_argument("--re-convergence-tol", type=float, default=1e-4)
+    from photon_tpu.cli.common import add_out_of_core_args
+
+    add_out_of_core_args(p)
     p.add_argument("--model-sparsity-threshold", type=float, default=0.0,
                    help="0 keeps all coefficients (exact warm-start round "
                         "trips across the incremental chain)")
@@ -180,6 +183,8 @@ def run(args) -> Dict:
         norm_drift_bound=args.norm_drift_bound,
         sparsity_threshold=args.model_sparsity_threshold,
         re_convergence_tol=args.re_convergence_tol,
+        re_device_budget_mb=args.re_device_budget_mb,
+        re_spill_dir=args.re_spill_dir,
         dead_letters=read_dead_letters(args.dead_letter_in),
         publish=not args.no_publish,
     )

@@ -68,9 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the unified run report (spans + metrics + "
                         "ingest-pipeline occupancy) as schema-stable JSONL "
                         "to this path")
-    from photon_tpu.cli.common import add_active_set_args
+    from photon_tpu.cli.common import add_active_set_args, add_out_of_core_args
 
     add_active_set_args(p)
+    add_out_of_core_args(p)
     return p
 
 
@@ -82,6 +83,13 @@ def run(args) -> Dict:
         logging.getLogger(__name__).warning(
             "--re-active-set is a no-op for the scoring driver (nothing is "
             "trained); it only affects GAME training"
+        )
+    if getattr(args, "re_device_budget_mb", None):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "--re-device-budget-mb is a no-op for the scoring driver "
+            "(nothing is trained); it only affects GAME training"
         )
     from photon_tpu.obs import begin_run, finalize_run_report
     from photon_tpu.utils.events import (

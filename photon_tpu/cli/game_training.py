@@ -55,9 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.common import add_validation_arg
 
     add_validation_arg(p)
-    from photon_tpu.cli.common import add_active_set_args
+    from photon_tpu.cli.common import add_active_set_args, add_out_of_core_args
 
     add_active_set_args(p)
+    add_out_of_core_args(p)
     p.add_argument("--validation-paths", nargs="*", default=None)
     p.add_argument("--coordinate-configurations", nargs="+", required=True)
     p.add_argument("--update-sequence", required=True,
@@ -395,6 +396,8 @@ def run(args) -> Dict:
         warm_start_model=warm,
         re_active_set=args.re_active_set,
         re_convergence_tol=args.re_convergence_tol,
+        re_device_budget_mb=args.re_device_budget_mb,
+        re_spill_dir=args.re_spill_dir,
     )
     from photon_tpu.utils.events import training_finish_event, training_start_event
 

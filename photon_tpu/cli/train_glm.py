@@ -153,9 +153,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "cache spills and later passes re-stream from disk "
                         "(host memory stays bounded either way)")
     add_validation_arg(p)
-    from photon_tpu.cli.common import add_active_set_args
+    from photon_tpu.cli.common import add_active_set_args, add_out_of_core_args
 
     add_active_set_args(p)
+    add_out_of_core_args(p)
     p.add_argument("--checkpoint-dir", default=None,
                    help="λ-sweep checkpoint/resume directory: one durable "
                         "step per completed λ (results + the warm-start "
@@ -261,6 +262,7 @@ def _stream_load_avro(args, path: str, index_map: Optional[IndexMap]):
         "spilled" if cache.spilled
         else f"{cache.cached_bytes >> 20} MiB held",
     )
+    cache.close()  # the batch is materialized; delete any disk spool now
     return batch.labeled_batch("features"), imap
 
 
@@ -294,6 +296,11 @@ def run(args) -> Dict:
         logging.getLogger(__name__).warning(
             "--re-active-set is a no-op for the single-GLM driver (no "
             "random-effect coordinates); it only affects GAME training"
+        )
+    if getattr(args, "re_device_budget_mb", None):
+        logging.getLogger(__name__).warning(
+            "--re-device-budget-mb is a no-op for the single-GLM driver "
+            "(no random-effect coordinates); it only affects GAME training"
         )
     task = task_of(args)
     stage = DriverStage.INIT

@@ -423,6 +423,7 @@ def compact_entity_blocks(
     blocks: Sequence[EntityBlock],
     keep: Sequence[np.ndarray],
     allowed_sizes: Optional[Sequence[int]] = None,
+    to_device: bool = True,
 ) -> List[Tuple[EntityBlock, np.ndarray, np.ndarray]]:
     """Repack the still-active rows of same-geometry dense blocks into the
     smallest already-compiled shapes (the active-set repack path).
@@ -441,6 +442,11 @@ def compact_entity_blocks(
     coefficients back needs no map at all, because compacted rows carry
     their real ``entity_idx`` and the coordinate's single drop-mode scatter
     already lands them.
+
+    ``to_device=False`` keeps the compacted block's leaves as host numpy —
+    the out-of-core path's upload stage does the ``device_put`` itself, so
+    compaction must not eagerly place blocks on device (that would double
+    the device footprint outside the residency budget).
     """
     if not blocks:
         return []
@@ -486,9 +492,10 @@ def compact_entity_blocks(
             ]
             if pad:
                 parts.append(pad_arr)
-            return jnp.asarray(
-                parts[0] if len(parts) == 1 else np.concatenate(parts)
-            )
+            merged = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            if not to_device:
+                return np.ascontiguousarray(merged)
+            return jnp.asarray(merged)
 
         block_c = EntityBlock(
             entity_idx=gather("entity_idx", np.full((pad,), -1, np.int32)),

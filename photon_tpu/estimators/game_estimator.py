@@ -122,6 +122,8 @@ class GameEstimator:
         warm_start_model=None,  # GameModel the flag reads existing ids from
         re_active_set: bool = False,
         re_convergence_tol: float = 1e-4,
+        re_device_budget_mb: Optional[float] = None,
+        re_spill_dir: Optional[str] = None,
     ):
         self.task = task
         self.coordinate_configs = list(coordinate_configs)
@@ -143,6 +145,15 @@ class GameEstimator:
         # effect passes for every RE coordinate of this estimator.
         self.re_active_set = bool(re_active_set)
         self.re_convergence_tol = float(re_convergence_tol)
+        # Out-of-core residency: device byte budget for every RE
+        # coordinate's block data + in-flight coefficients (None → fully
+        # resident). See algorithm/re_store.ReDeviceStore.
+        self.re_device_budget_bytes = (
+            int(re_device_budget_mb * (1 << 20))
+            if re_device_budget_mb
+            else None
+        )
+        self.re_spill_dir = re_spill_dir
         if self.ignore_threshold_for_new_models and warm_start_model is None:
             raise ValueError(
                 "'Ignore threshold for new models' flag set but no initial "
@@ -213,6 +224,8 @@ class GameEstimator:
                         if cfg.convergence_tol is not None
                         else self.re_convergence_tol
                     ),
+                    device_budget_bytes=self.re_device_budget_bytes,
+                    device_spill_dir=self.re_spill_dir,
                 )
             else:
                 raise TypeError(f"unknown coordinate config {type(cfg)}")

@@ -45,7 +45,9 @@ import dataclasses
 import json
 import logging
 import os
+import pickle
 import queue
+import tempfile
 import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
@@ -497,6 +499,86 @@ def _run_staged(
             t.join(timeout=10.0)
 
 
+class StageWorker:
+    """One pipeline stage OUTSIDE a source→consumer chain: bounded input
+    queue, a single worker thread, StageStats accounting, and failure
+    propagation back to the submitting thread.
+
+    ``_run_staged`` composes stages that flow source → consumer; the
+    out-of-core RE store's d2h download stage flows the OPPOSITE way (the
+    dispatching consumer produces work for a draining worker), so it gets
+    its own primitive with the same queue discipline: ``submit`` blocks when
+    the worker is ``depth`` items behind (backpressure — the time shows up
+    as the stage's backpressured wall), and a worker failure surfaces at the
+    next ``submit`` or at ``close``. Items are processed strictly in
+    submission order."""
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        stage: StageStats,
+        depth: int = DEFAULT_QUEUE_DEPTH,
+        nbytes_of: Callable = lambda item, out: 0,
+    ):
+        self.name = name
+        self._fn = fn
+        self._stage = stage
+        self._nbytes = nbytes_of
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._failure: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"photon-pipe-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            t0 = time.perf_counter()
+            item = _get(self._q, self._stop)
+            self._stage.add_wait_in(time.perf_counter() - t0)
+            if item is _DONE:
+                return
+            t1 = time.perf_counter()
+            try:
+                out = self._fn(item)
+            except BaseException as exc:  # noqa: BLE001 — forwarded to submitter
+                self._failure = exc
+                self._stop.set()
+                return
+            self._stage.add_busy(time.perf_counter() - t1, self._nbytes(item, out))
+
+    def submit(self, item) -> None:
+        """Enqueue one item (blocking under backpressure). Raises the
+        worker's failure if it already died."""
+        if self._failure is not None:
+            raise self._failure
+        t0 = time.perf_counter()
+        if not _put(self._q, item, self._stop):
+            if self._failure is not None:
+                raise self._failure
+            raise RuntimeError(f"stage worker {self.name!r} stopped")
+        self._stage.add_wait_out(time.perf_counter() - t0)
+        self._stage.sample_depth(self._q.qsize())
+
+    def close(self, timeout: float = 600.0) -> None:
+        """Drain the queue, stop the worker, and re-raise any failure."""
+        _put(self._q, _DONE, self._stop)
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            self._stop.set()
+            raise RuntimeError(
+                f"stage worker {self.name!r} did not drain within {timeout}s"
+            )
+        if self._failure is not None:
+            raise self._failure
+
+    def abort(self) -> None:
+        """Stop without draining (error-path cleanup); never raises."""
+        self._stop.set()
+
+
 # ---------------------------------------------------------------------------
 # Concrete stages: decode → assemble → h2d over GameBatch chunks.
 # ---------------------------------------------------------------------------
@@ -760,13 +842,18 @@ class ChunkReplayCache:
     :func:`stream_host_batches` — decode + assembly) and tees each chunk
     into memory while the running total stays within ``byte_budget``. Later
     passes replay from memory — decode and assembly are never paid again.
-    If the stream outgrows the budget, the cache SPILLS: it drops what it
-    held and every pass (including the current one) streams from the
-    source, so host memory stays bounded by the budget plus one in-flight
-    chunk either way.
+    If the stream outgrows the budget, the overflow SPILLS TO DISK: the
+    in-memory prefix stays put and every later chunk is pickled to a spool
+    file under ``spill_dir``, so replay passes read memory + disk in the
+    original order and the decode is still paid exactly once. Host memory
+    stays bounded by the budget plus one in-flight chunk. ``spill_dir`` of
+    ``"auto"`` (the default) lazily creates a temp directory on first
+    spill; ``None`` restores the legacy fallback — drop the cache and
+    re-stream every pass from the source.
 
     Single-consumer: passes must not interleave. A pass abandoned mid-way
-    leaves the cache incomplete and the next pass re-streams.
+    leaves the cache incomplete (and deletes its spool); the next pass
+    re-streams.
     """
 
     def __init__(
@@ -774,16 +861,53 @@ class ChunkReplayCache:
         source_factory: Callable[[], Iterator[BatchChunk]],
         byte_budget: int = 1 << 30,
         nbytes: Callable = chunk_nbytes,
+        spill_dir: Optional[str] = "auto",
     ):
         self._factory = source_factory
         self.byte_budget = int(byte_budget)
         self._nbytes = nbytes
+        self._spill_dir = spill_dir
+        self._spool_path: Optional[str] = None
+        self._spool_count = 0
+        self._spool_seq = 0
         self._chunks: List[BatchChunk] = []
         self._complete = False
         self.spilled = False
         self.cached_bytes = 0
+        self.spilled_bytes = 0
         self.source_passes = 0
         self.replay_passes = 0
+
+    def _reset_cache(self) -> None:
+        self._chunks, self.cached_bytes = [], 0
+        self.spilled_bytes = 0
+        self._spool_count = 0
+        if self._spool_path is not None:
+            try:
+                os.unlink(self._spool_path)
+            except OSError:
+                pass
+            self._spool_path = None
+
+    def _open_spool(self):
+        if self._spill_dir == "auto":
+            self._spill_dir = tempfile.mkdtemp(prefix="photon-replay-")
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self._spool_path = os.path.join(
+            self._spill_dir, f"spool-{self._spool_seq:04d}.pkl"
+        )
+        self._spool_seq += 1
+        return open(self._spool_path, "wb")
+
+    def _read_spool(self) -> Iterator[BatchChunk]:
+        with open(self._spool_path, "rb") as fh:
+            for _ in range(self._spool_count):
+                yield pickle.load(fh)
+
+    def close(self) -> None:
+        """Drop the cache and delete any spool file."""
+        self._complete = False
+        self._reset_cache()
 
     def __iter__(self) -> Iterator[BatchChunk]:
         reg = registry()
@@ -791,28 +915,53 @@ class ChunkReplayCache:
             self.replay_passes += 1
             reg.counter("replay_cache_replay_passes_total").inc()
             yield from self._chunks
+            if self._spool_count:
+                yield from self._read_spool()
             return
         self.source_passes += 1
         reg.counter("replay_cache_source_passes_total").inc()
-        self._chunks, self.cached_bytes = [], 0
-        caching = not self.spilled
+        self._reset_cache()
+        # A memory-only cache that overflowed once never tries again (the
+        # stream is known not to fit); a disk-backed cache retries, since a
+        # fresh pass rebuilds both the memory prefix and the spool.
+        caching = not self.spilled or self._spill_dir is not None
+        spool = None
         finished = False
         try:
             for chunk in self._factory():
                 if caching:
-                    self.cached_bytes += self._nbytes(chunk)
-                    if self.cached_bytes > self.byte_budget:
-                        self.spilled, caching = True, False
-                        self._chunks, self.cached_bytes = [], 0
-                        reg.counter("replay_cache_spills_total").inc()
-                    else:
-                        self._chunks.append(chunk)
+                    cost = self._nbytes(chunk)
+                    if spool is None and self.cached_bytes + cost > self.byte_budget:
+                        if self._spill_dir is None:
+                            self.spilled, caching = True, False
+                            self._reset_cache()
+                            reg.counter("replay_cache_spills_total").inc()
+                        else:
+                            spool = self._open_spool()
+                            self.spilled = True
+                            reg.counter("replay_cache_spills_total").inc()
+                    if caching:
+                        if spool is None:
+                            self._chunks.append(chunk)
+                            self.cached_bytes += cost
+                        else:
+                            pickle.dump(
+                                chunk, spool, protocol=pickle.HIGHEST_PROTOCOL
+                            )
+                            self._spool_count += 1
+                            self.spilled_bytes += cost
+                            reg.counter(
+                                "replay_cache_spilled_bytes_total"
+                            ).inc(cost)
                 yield chunk
             finished = True
         finally:
+            if spool is not None:
+                spool.close()
             if finished and caching:
                 self._complete = True
             elif not finished:
-                self._chunks, self.cached_bytes = [], 0
+                self._reset_cache()
             reg.gauge("replay_cache_cached_bytes").set(self.cached_bytes)
+            reg.gauge("replay_cache_spilled_bytes").set(self.spilled_bytes)
             reg.gauge("replay_cache_spilled").set(int(self.spilled))

@@ -35,8 +35,21 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+# Import gate: pallas is an experimental surface that some CPU-only jax
+# installs ship without (and whose API names move between releases).
+# Importing THIS module must never break a training process that isn't
+# using the fused path — record the failure and let the predicates below
+# report it instead.
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORT_ERROR: Optional[BaseException] = None
+except Exception as _exc:  # pragma: no cover - depends on jax build
+    pl = None  # type: ignore[assignment]
+    pltpu = None  # type: ignore[assignment]
+    _PALLAS_IMPORT_ERROR = _exc
 
 from photon_tpu.ops.losses import PointwiseLoss
 
@@ -47,7 +60,48 @@ Array = jax.Array
 # the constant output index map, but megacore parts (v4/v5p) split
 # "parallel" grid dims across cores — declare the semantics explicitly so
 # the reduction stays correct everywhere, not just on single-core v5e.
-_SEQUENTIAL_GRID = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+# (jax renamed TPUCompilerParams → CompilerParams across releases; accept
+# whichever this build ships.)
+_COMPILER_PARAMS_CLS = (
+    None
+    if pltpu is None
+    else getattr(pltpu, "CompilerParams", None)
+    or getattr(pltpu, "TPUCompilerParams", None)
+)
+_SEQUENTIAL_GRID = (
+    _COMPILER_PARAMS_CLS(dimension_semantics=("arbitrary",))
+    if _COMPILER_PARAMS_CLS is not None
+    else None
+)
+
+
+def pallas_usable() -> bool:
+    """True when the fused kernels can EXECUTE in this process — compiled
+    on a TPU backend, or interpreted elsewhere (the CPU test path). False
+    only when the pallas import itself failed."""
+    return _PALLAS_IMPORT_ERROR is None
+
+
+def pallas_available() -> bool:
+    """True when the fused kernels can COMPILE and run at full speed: the
+    pallas TPU surface imported, Mosaic compiler params resolved, and the
+    default backend is a TPU. Off-TPU the kernels still run in interpreter
+    mode (orders slower) — production call sites gate on this; tests opt
+    into ``interpret=True`` explicitly."""
+    return (
+        _PALLAS_IMPORT_ERROR is None
+        and _SEQUENTIAL_GRID is not None
+        and jax.default_backend() == "tpu"
+    )
+
+
+def _require_pallas() -> None:
+    if _PALLAS_IMPORT_ERROR is not None:
+        raise RuntimeError(
+            "pallas is unavailable in this jax build "
+            f"({_PALLAS_IMPORT_ERROR!r}); the fused GLM kernels cannot run "
+            "— strip use_pallas or install a jax with pallas support"
+        )
 
 # Requested row-tile height; the VMEM budget below is the real constraint
 # (tile_cap), so this just needs to be "large". Grid steps run sequentially
@@ -136,6 +190,7 @@ def fused_data_hvp(
     which caches d2 once per outer iteration
     (HessianVectorAggregator.scala role). Padding is exact (zero rows /
     columns contribute nothing)."""
+    _require_pallas()
     n, d = X.shape
     _check_fused_width(d, "fused_data_hvp")
     if interpret is None:
@@ -226,6 +281,7 @@ def fused_data_value_and_grad(
     the margin-space L-BFGS uses this to refresh its carried margins exactly
     every iteration instead of accumulating ``z += α·u`` rounding drift.
     """
+    _require_pallas()
     n, d = X.shape
     _check_fused_width(d, "fused_data_value_and_grad")
     if interpret is None:

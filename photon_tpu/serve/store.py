@@ -18,6 +18,19 @@ entity ids pass through as slots, the miss path never runs. Unknown/cold
 entities resolve to slot -1 and score 0, exactly the batch path's
 cold-start semantics.
 
+Projected (subspace) random-effect models get the same treatment at BLOCK
+granularity: each per-block subspace table keeps a hot row pool, and the
+device-resident ``entity_block``/``entity_row`` maps are rewritten by
+scatter as entities promote and demote (a demoted entity's map entry goes
+to -1 — it can never be read for a requested entity, because ``resolve``
+promotes every entity of the batch before the scorer runs). Entity ids pass
+through as indices for projected types either way, pinned or not.
+
+The LRU policy itself (recency order, in-use protection, demotion
+accounting) lives in data/residency.py — shared verbatim with the
+out-of-core TRAINING store (algorithm/re_store.py), so serving and training
+cannot drift on residency semantics.
+
 Zero-downtime reload builds a NEW store (and scorer) for the incoming model
 while the old one keeps serving, then swaps atomically — see
 serve/engine.py. The store itself is single-writer: the engine serializes
@@ -27,12 +40,12 @@ serve/engine.py. The store itself is single-writer: the engine serializes
 from __future__ import annotations
 
 import dataclasses
-from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from photon_tpu.data.random_effect import bucket_dim
+from photon_tpu.data.residency import SlotLru
 from photon_tpu.models.game import (
     FixedEffectModel,
     GameModel,
@@ -47,10 +60,10 @@ _scatter_rows = None
 
 def _scatter(table, idx, rows):
     """Jitted hot-table row upload. ``idx`` is padded to a bucketed length
-    with the out-of-range value H (``mode="drop"`` discards it — NB negative
+    with an out-of-range value (``mode="drop"`` discards it — NB negative
     indices WRAP in XLA scatters, so high-out-of-range is the safe filler).
-    One executable per (H, d, m_bucket) shape; ``warm_uploads`` compiles
-    them before traffic."""
+    One executable per shape triple; ``warm_uploads`` compiles them before
+    traffic. Shared by 2-D coefficient-table and 1-D entity-map scatters."""
     global _scatter_rows
     if _scatter_rows is None:
         import jax
@@ -74,14 +87,48 @@ class _ReGroup:
     capacity: int  # H: hot rows (== num_entities when pinned)
     pinned: bool
     tables: Dict[str, object] = dataclasses.field(default_factory=dict)
-    slot_of: "OrderedDict[int, int]" = dataclasses.field(
-        default_factory=OrderedDict
-    )
-    free_slots: List[int] = dataclasses.field(default_factory=list)
+    lru: Optional[SlotLru] = None
 
     @property
     def row_bytes(self) -> int:
         return sum(4 * c.shape[1] for c in self.host_coefs.values())
+
+
+@dataclasses.dataclass
+class _ProjCoord:
+    """One projected coordinate's hot state: per-block hot tables + the
+    device entity→(block, row) maps the scorer gathers through."""
+
+    cid: str
+    sub: ProjectedRandomEffectModel  # host master (block_coefs as numpy)
+    host_blocks: List[np.ndarray]  # [(E_b, d_b) float32]
+    entity_block: np.ndarray  # (E,) host master map
+    entity_row: np.ndarray  # (E,)
+    capacities: List[int]  # hot rows per block
+    lrus: List[Optional[SlotLru]]  # entity id -> hot row, per block
+    tables: List[object]  # device [(H_b, d_b)]
+    dev_entity_block: object  # device (E,) int32; -1 = cold (scores 0)
+    dev_entity_row: object  # device (E,) int32
+    demoted: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def hot_bytes(self) -> int:
+        return sum(
+            4 * h * b.shape[1] for h, b in zip(self.capacities, self.host_blocks)
+        )
+
+
+@dataclasses.dataclass
+class _ProjGroup:
+    """Projected coordinates sharing one RE type. Unlike dense groups they
+    need no shared slot space: ``resolve`` returns entity INDICES (the
+    per-coordinate device maps translate entity → hot row), so each
+    coordinate promotes into its own block tables independently."""
+
+    re_type: str
+    num_entities: int
+    coords: List[_ProjCoord]
+    pinned: bool  # every coordinate fully resident → no promotion path
 
 
 class HotColdEntityStore:
@@ -105,32 +152,31 @@ class HotColdEntityStore:
 
         self._entity_indexes = dict(entity_indexes or {})
         self._groups: Dict[str, _ReGroup] = {}
+        self._proj_groups: Dict[str, _ProjGroup] = {}
         self._re_subs: Dict[str, RandomEffectModel] = {}
-        # RE types whose tables serve fully device-resident OUTSIDE the LRU
-        # (projected models): entity ids pass straight through as indices.
-        self._passthrough: Dict[str, int] = {}
         base: Dict[str, object] = {}
 
         by_type: Dict[str, List] = {}
+        proj_by_type: Dict[str, List] = {}
         for cid, sub in model.models.items():
             if isinstance(sub, RandomEffectModel):
                 by_type.setdefault(sub.re_type, []).append((cid, sub))
+            elif isinstance(sub, ProjectedRandomEffectModel):
+                proj_by_type.setdefault(sub.re_type, []).append((cid, sub))
             else:
-                # Fixed effects and projected RE models serve device-resident
-                # as-is (projected tables are already the compact subspace
-                # form — their hot/cold split is an open item).
-                if isinstance(sub, ProjectedRandomEffectModel):
-                    self._passthrough[sub.re_type] = max(
-                        self._passthrough.get(sub.re_type, 0),
-                        int(sub.num_entities),
-                    )
                 base[cid] = jax.device_put(sub)
 
+        # One budget pool across dense AND projected types, split
+        # proportionally to each type's full table size.
         budget_total = sum(
             sum(4 * np.asarray(s.coefficients).shape[1] for _, s in subs)
             * max(np.asarray(subs[0][1].coefficients).shape[0], 1)
             for subs in by_type.values()
+        ) + sum(
+            sum(self._proj_full_bytes(s) for _, s in subs)
+            for subs in proj_by_type.values()
         )
+        reg = registry()
         for re_type, subs in by_type.items():
             host = {
                 cid: np.ascontiguousarray(
@@ -176,11 +222,12 @@ class HotColdEntityStore:
                     )
                     for cid in group.coord_ids
                 }
-                group.free_slots = list(range(group.capacity - 1, -1, -1))
+                group.lru = SlotLru(
+                    group.capacity, on_demote=self._demote_counter(re_type)
+                )
             self._groups[re_type] = group
             for cid, s in subs:
                 self._re_subs[cid] = s
-            reg = registry()
             reg.gauge("serve_store_hot_rows", re_type=re_type).set(
                 group.capacity
             )
@@ -188,7 +235,129 @@ class HotColdEntityStore:
                 group.capacity * row_bytes
             )
             reg.gauge("serve_store_pinned", re_type=re_type).set(int(pinned))
+        for re_type, subs in proj_by_type.items():
+            group = self._build_proj_group(
+                re_type, subs, hot_bytes, budget_total, min_hot_rows
+            )
+            self._proj_groups[re_type] = group
+            hot = sum(c.hot_bytes for c in group.coords)
+            reg.gauge("serve_store_hot_rows", re_type=re_type).set(
+                sum(sum(c.capacities) for c in group.coords)
+            )
+            reg.gauge("serve_store_hot_bytes", re_type=re_type).set(hot)
+            reg.gauge("serve_store_pinned", re_type=re_type).set(
+                int(group.pinned)
+            )
         self._base = base
+
+    @staticmethod
+    def _proj_full_bytes(sub: ProjectedRandomEffectModel) -> int:
+        return sum(
+            4 * np.asarray(b).shape[0] * np.asarray(b).shape[1]
+            for b in sub.block_coefs
+        )
+
+    def _demote_counter(self, re_type: str):
+        def on_demote(_victim, _slot):
+            registry().counter(
+                "serve_store_demotions_total", re_type=re_type
+            ).inc()
+
+        return on_demote
+
+    def _build_proj_group(
+        self, re_type, subs, hot_bytes, budget_total, min_hot_rows
+    ) -> _ProjGroup:
+        """Per-block hot/cold state for projected coordinates. Budget share
+        splits across a coordinate's blocks proportionally to block size,
+        floored at ``min_hot_rows`` rows per block — any one batch's
+        entities may all land in one block, so every block must be able to
+        hold a full batch's worth of hot rows simultaneously."""
+        import jax
+
+        coords: List[_ProjCoord] = []
+        num_entities = 0
+        for cid, sub in subs:
+            host_blocks = [
+                np.ascontiguousarray(np.asarray(b, dtype=np.float32))
+                for b in sub.block_coefs
+            ]
+            entity_block = np.asarray(sub.entity_block, np.int32)
+            entity_row = np.asarray(sub.entity_row, np.int32)
+            E = int(entity_block.shape[0])
+            num_entities = max(num_entities, E)
+            full_bytes = sum(4 * b.shape[0] * b.shape[1] for b in host_blocks)
+            share = (
+                int(hot_bytes * full_bytes / budget_total)
+                if budget_total
+                else hot_bytes
+            )
+            capacities: List[int] = []
+            for b in host_blocks:
+                b_bytes = 4 * b.shape[0] * max(b.shape[1], 1)
+                b_share = (
+                    int(share * b_bytes / full_bytes) if full_bytes else share
+                )
+                cap = max(
+                    int(min_hot_rows), b_share // max(4 * b.shape[1], 1)
+                )
+                capacities.append(max(min(cap, b.shape[0]), 1))
+            pinned = all(
+                c >= b.shape[0] for c, b in zip(capacities, host_blocks)
+            )
+            demoted: List[int] = []
+            if pinned:
+                capacities = [b.shape[0] for b in host_blocks]
+                tables = [jax.device_put(b) for b in host_blocks]
+                lrus: List[Optional[SlotLru]] = [None] * len(host_blocks)
+                dev_entity_block = jax.device_put(entity_block)
+                dev_entity_row = jax.device_put(entity_row)
+            else:
+                tables = [
+                    jax.device_put(np.zeros((c, b.shape[1]), np.float32))
+                    for c, b in zip(capacities, host_blocks)
+                ]
+                demote = self._proj_demoter(re_type, demoted)
+                lrus = [SlotLru(c, on_demote=demote) for c in capacities]
+                # Everything starts COLD: map entries are -1 until promoted.
+                dev_entity_block = jax.device_put(
+                    np.full((E,), -1, np.int32)
+                )
+                dev_entity_row = jax.device_put(np.zeros((E,), np.int32))
+            coords.append(
+                _ProjCoord(
+                    cid=cid,
+                    sub=sub,
+                    host_blocks=host_blocks,
+                    entity_block=entity_block,
+                    entity_row=entity_row,
+                    capacities=capacities,
+                    lrus=lrus,
+                    tables=tables,
+                    dev_entity_block=dev_entity_block,
+                    dev_entity_row=dev_entity_row,
+                    demoted=demoted,
+                )
+            )
+        return _ProjGroup(
+            re_type=re_type,
+            num_entities=num_entities,
+            coords=coords,
+            pinned=all(self._coord_pinned(c) for c in coords),
+        )
+
+    def _proj_demoter(self, re_type: str, demoted: List[int]):
+        counter = self._demote_counter(re_type)
+
+        def on_demote(victim, slot):
+            demoted.append(int(victim))
+            counter(victim, slot)
+
+        return on_demote
+
+    @staticmethod
+    def _coord_pinned(coord: _ProjCoord) -> bool:
+        return all(lru is None for lru in coord.lrus)
 
     # -- residency ---------------------------------------------------------
 
@@ -199,14 +368,17 @@ class HotColdEntityStore:
 
     @property
     def entity_re_types(self) -> List[str]:
-        """Every RE type a batch must carry entity ids for — managed groups
-        plus passthrough (projected) types."""
+        """Every RE type a batch must carry entity ids for — dense managed
+        groups plus projected (entity-index-addressed) types."""
         return list(self._groups) + [
-            t for t in self._passthrough if t not in self._groups
+            t for t in self._proj_groups if t not in self._groups
         ]
 
     def group(self, re_type: str) -> Optional[_ReGroup]:
         return self._groups.get(re_type)
+
+    def proj_group(self, re_type: str) -> Optional[_ProjGroup]:
+        return self._proj_groups.get(re_type)
 
     def _intern(self, re_type: str, key, num_entities: int) -> int:
         """Request entity key → dense [0, E) index; -1 when unknown."""
@@ -218,21 +390,24 @@ class HotColdEntityStore:
         return i if 0 <= i < num_entities else -1
 
     def resolve(self, re_type: str, keys: Sequence) -> np.ndarray:
-        """Entity keys (interned ints or raw string ids) → hot-table slots,
-        promoting misses from the host master. -1 rows (cold start) pass
-        through and score 0. Single-writer: the engine's batch lock
-        serializes calls."""
+        """Entity keys (interned ints or raw string ids) → hot-table slots
+        (dense groups) or entity indices (projected groups), promoting
+        misses from the host master. -1 rows (cold start) pass through and
+        score 0. Single-writer: the engine's batch lock serializes calls."""
         faults.check("serve.store_resolve", label=re_type)
         group = self._groups.get(re_type)
         if group is None:
-            E = self._passthrough.get(re_type)
-            if E is None:
+            proj = self._proj_groups.get(re_type)
+            if proj is None:
                 return np.full(len(keys), -1, np.int32)
-            return np.fromiter(
-                (self._intern(re_type, k, E) for k in keys),
+            ids = np.fromiter(
+                (self._intern(re_type, k, proj.num_entities) for k in keys),
                 dtype=np.int32,
                 count=len(keys),
             )
+            if not proj.pinned:
+                self._promote_projected(proj, ids)
+            return ids
         ids = np.fromiter(
             (self._intern(re_type, k, group.num_entities) for k in keys),
             dtype=np.int64,
@@ -251,14 +426,12 @@ class HotColdEntityStore:
             if e < 0:
                 slots[j] = -1
                 continue
-            slot = group.slot_of.get(e)
+            slot = group.lru.get(e)
             if slot is not None:
-                group.slot_of.move_to_end(e)
                 if e not in in_use and e not in misses:
                     hits += 1
             else:
-                slot = self._claim_slot(group, in_use)
-                group.slot_of[e] = slot
+                slot = self._claim_slot(group, e, in_use)
                 misses.append(e)
             in_use.add(e)
             slots[j] = slot
@@ -271,22 +444,16 @@ class HotColdEntityStore:
             self._upload(group, misses)
         return slots
 
-    def _claim_slot(self, group: _ReGroup, in_use: set) -> int:
-        if group.free_slots:
-            return group.free_slots.pop()
-        # Demote the least-recently-used entity that is NOT part of the
+    def _claim_slot(self, group: _ReGroup, entity: int, in_use: set) -> int:
+        # Demotes the least-recently-used entity that is NOT part of the
         # current batch. capacity ≥ max batch size guarantees a victim.
-        for victim in group.slot_of:
-            if victim not in in_use:
-                slot = group.slot_of.pop(victim)
-                registry().counter(
-                    "serve_store_demotions_total", re_type=group.re_type
-                ).inc()
-                return slot
-        raise RuntimeError(
-            f"hot store for {group.re_type!r} exhausted: batch has more "
-            f"unique entities than capacity {group.capacity}"
-        )
+        try:
+            return group.lru.claim(entity, in_use)
+        except RuntimeError:
+            raise RuntimeError(
+                f"hot store for {group.re_type!r} exhausted: batch has more "
+                f"unique entities than capacity {group.capacity}"
+            ) from None
 
     def _upload(self, group: _ReGroup, entities: List[int]) -> None:
         """One bucketed scatter per coordinate: miss count pads up the
@@ -295,7 +462,7 @@ class HotColdEntityStore:
         m = len(entities)
         m_b = bucket_dim(m)
         idx = np.full(m_b, group.capacity, np.int32)
-        idx[:m] = [group.slot_of[e] for e in entities]
+        idx[:m] = [group.lru.peek(e) for e in entities]
         ent = np.asarray(entities, np.int64)
         for cid in group.coord_ids:
             host = group.host_coefs[cid]
@@ -303,10 +470,121 @@ class HotColdEntityStore:
             rows[:m] = host[ent]
             group.tables[cid] = _scatter(group.tables[cid], idx, rows)
 
+    def _promote_projected(self, proj: _ProjGroup, ids: np.ndarray) -> None:
+        """Promote this batch's entities into each projected coordinate's
+        per-block hot tables and rewrite the device entity maps. A demoted
+        victim's map entry is scattered to -1 in the same pass — stale rows
+        are never read because every REQUESTED entity is promoted here,
+        before the scorer runs."""
+        reg = registry()
+        batch_ids = [int(e) for e in ids if e >= 0]
+        for coord in proj.coords:
+            if self._coord_pinned(coord):
+                continue
+            faults.check("serve.store_upload", label=proj.re_type)
+            # Entities of this batch grouped by their host block, for the
+            # per-block in-use protection sets.
+            in_use_by_block: Dict[int, set] = {}
+            for e in batch_ids:
+                b = int(coord.entity_block[e])
+                if b >= 0:
+                    in_use_by_block.setdefault(b, set()).add(e)
+            misses: List[int] = []  # promoted entity ids, slot assigned
+            rows_of: Dict[int, int] = {}
+            hits = 0
+            seen = set()
+            for e in batch_ids:
+                if e in seen:
+                    continue
+                seen.add(e)
+                b = int(coord.entity_block[e])
+                if b < 0:
+                    continue  # entity has no model in this coordinate
+                lru = coord.lrus[b]
+                slot = lru.get(e)
+                if slot is not None:
+                    hits += 1
+                    continue
+                slot = self._claim_proj_slot(
+                    proj, coord, b, e, in_use_by_block[b]
+                )
+                rows_of[e] = slot
+                misses.append(e)
+            if hits:
+                reg.counter(
+                    "serve_store_hits_total", re_type=proj.re_type
+                ).inc(hits)
+            if not misses and not coord.demoted:
+                continue
+            if misses:
+                reg.counter(
+                    "serve_store_misses_total", re_type=proj.re_type
+                ).inc(len(misses))
+                self._upload_projected_rows(coord, misses, rows_of)
+            self._rewrite_proj_maps(proj, coord, misses, rows_of)
+
+    def _claim_proj_slot(
+        self, proj: _ProjGroup, coord: _ProjCoord, block: int, entity: int,
+        in_use: set,
+    ) -> int:
+        try:
+            return coord.lrus[block].claim(entity, in_use)
+        except RuntimeError:
+            raise RuntimeError(
+                f"hot store for {proj.re_type!r} exhausted: batch has more "
+                f"unique entities in block {block} than capacity "
+                f"{coord.capacities[block]}"
+            ) from None
+
+    def _upload_projected_rows(
+        self, coord: _ProjCoord, misses: List[int], rows_of: Dict[int, int]
+    ) -> None:
+        """Bucketed row scatter per block that has promotions."""
+        by_block: Dict[int, List[int]] = {}
+        for e in misses:
+            by_block.setdefault(int(coord.entity_block[e]), []).append(e)
+        for b, ents in by_block.items():
+            m = len(ents)
+            m_b = bucket_dim(m)
+            idx = np.full(m_b, coord.capacities[b], np.int32)
+            idx[:m] = [rows_of[e] for e in ents]
+            host = coord.host_blocks[b]
+            rows = np.zeros((m_b, host.shape[1]), np.float32)
+            rows[:m] = host[coord.entity_row[np.asarray(ents, np.int64)]]
+            coord.tables[b] = _scatter(coord.tables[b], idx, rows)
+
+    def _rewrite_proj_maps(
+        self, proj: _ProjGroup, coord: _ProjCoord, misses: List[int],
+        rows_of: Dict[int, int],
+    ) -> None:
+        """One bucketed scatter pair updating the device entity maps for
+        this resolve: promoted entities point at their new hot rows,
+        demotion victims go cold (-1)."""
+        # Drain IN PLACE: the SlotLru on_demote closures captured this list
+        # object at build time — rebinding would orphan it and every later
+        # victim would silently keep its stale (hot) map entry.
+        victims = list(coord.demoted)
+        coord.demoted.clear()
+        m = len(misses) + len(victims)
+        m_b = bucket_dim(m)
+        E = coord.entity_block.shape[0]
+        idx = np.full(m_b, E, np.int32)  # out-of-range filler → dropped
+        blk = np.full(m_b, -1, np.int32)
+        row = np.zeros(m_b, np.int32)
+        idx[: len(victims)] = victims
+        for j, e in enumerate(misses):
+            idx[len(victims) + j] = e
+            blk[len(victims) + j] = int(coord.entity_block[e])
+            row[len(victims) + j] = rows_of[e]
+        coord.dev_entity_block = _scatter(coord.dev_entity_block, idx, blk)
+        coord.dev_entity_row = _scatter(coord.dev_entity_row, idx, row)
+
     def warm_uploads(self, max_batch: int) -> None:
         """Compile the upload scatters for every miss-count bucket ≤
         ``max_batch`` (no-op rows: every filler index drops), so promotion
-        never compiles under a request."""
+        never compiles under a request. Projected map scatters warm to
+        2×max_batch — one resolve may rewrite a miss AND a victim entry per
+        promoted entity."""
         import jax
 
         for group in self._groups.values():
@@ -326,6 +604,40 @@ class HotColdEntityStore:
                 m = m_b + 1
             for cid in group.coord_ids:
                 jax.block_until_ready(group.tables[cid])
+        for proj in self._proj_groups.values():
+            for coord in proj.coords:
+                if self._coord_pinned(coord):
+                    continue
+                E = coord.entity_block.shape[0]
+                m = 1
+                while True:
+                    m_b = bucket_dim(m)
+                    for b, table in enumerate(coord.tables):
+                        idx = np.full(m_b, coord.capacities[b], np.int32)
+                        coord.tables[b] = _scatter(
+                            table, idx,
+                            np.zeros((m_b, table.shape[1]), np.float32),
+                        )
+                    if m_b >= bucket_dim(2 * max_batch):
+                        break
+                    m = m_b + 1
+                m = 1
+                while True:
+                    m_b = bucket_dim(m)
+                    idx = np.full(m_b, E, np.int32)
+                    zeros = np.zeros(m_b, np.int32)
+                    coord.dev_entity_block = _scatter(
+                        coord.dev_entity_block, idx, zeros
+                    )
+                    coord.dev_entity_row = _scatter(
+                        coord.dev_entity_row, idx, zeros
+                    )
+                    if m_b >= bucket_dim(2 * max_batch):
+                        break
+                    m = m_b + 1
+                jax.block_until_ready(coord.dev_entity_block)
+                for table in coord.tables:
+                    jax.block_until_ready(table)
 
     # -- scoring model -----------------------------------------------------
 
@@ -340,6 +652,23 @@ class HotColdEntityStore:
                 models[cid] = self._re_subs[cid].with_coefficients(
                     group.tables[cid]
                 )
+        for proj in self._proj_groups.values():
+            for coord in proj.coords:
+                sub = coord.sub
+                # Auxiliary arrays (variances) are dropped like the dense
+                # ``with_coefficients`` path: one pytree structure across
+                # reloads, never a retrace on swap.
+                models[coord.cid] = ProjectedRandomEffectModel(
+                    block_coefs=list(coord.tables),
+                    col_maps=list(sub.col_maps),
+                    inv_maps=list(sub.inv_maps),
+                    entity_block=coord.dev_entity_block,
+                    entity_row=coord.dev_entity_row,
+                    d_full=sub.d_full,
+                    re_type=sub.re_type,
+                    feature_shard=sub.feature_shard,
+                    task=sub.task,
+                )
         return GameModel(models)
 
     def stats(self) -> Dict[str, dict]:
@@ -349,9 +678,25 @@ class HotColdEntityStore:
                 entities=group.num_entities,
                 hot_capacity=group.capacity,
                 hot_resident=(
-                    group.num_entities if group.pinned else len(group.slot_of)
+                    group.num_entities
+                    if group.pinned
+                    else len(group.lru)
                 ),
                 pinned=group.pinned,
                 hot_bytes=group.capacity * group.row_bytes,
+            )
+        for re_type, proj in self._proj_groups.items():
+            out[re_type] = dict(
+                entities=proj.num_entities,
+                hot_capacity=sum(sum(c.capacities) for c in proj.coords),
+                hot_resident=sum(
+                    sum(c.capacities)
+                    if self._coord_pinned(c)
+                    else sum(len(l) for l in c.lrus if l is not None)
+                    for c in proj.coords
+                ),
+                pinned=proj.pinned,
+                hot_bytes=sum(c.hot_bytes for c in proj.coords),
+                projected=True,
             )
         return out

@@ -216,6 +216,8 @@ def incremental_update(
     norm_drift_bound: float = 10.0,
     sparsity_threshold: float = 0.0,
     re_convergence_tol: float = 1e-4,
+    re_device_budget_mb: Optional[float] = None,
+    re_spill_dir: Optional[str] = None,
     dead_letters: Optional[List[dict]] = None,
     publish: bool = True,
 ) -> IncrementalResult:
@@ -267,6 +269,8 @@ def incremental_update(
         ignore_threshold_for_new_models=parent is not None,
         re_active_set=True,
         re_convergence_tol=re_convergence_tol,
+        re_device_budget_mb=re_device_budget_mb,
+        re_spill_dir=re_spill_dir,
     )
     results = estimator.fit(
         batch,

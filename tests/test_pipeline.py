@@ -216,6 +216,7 @@ def test_replay_cache_replays_without_second_decode():
 
 
 def test_replay_cache_spills_over_budget_and_restreams():
+    """Legacy fallback (spill_dir=None): over budget → drop and re-stream."""
     chunks = _fake_chunks(k=4, rows=100)  # 800 B per chunk
     pulls = {"n": 0}
 
@@ -223,12 +224,66 @@ def test_replay_cache_spills_over_budget_and_restreams():
         pulls["n"] += 1
         yield from chunks
 
-    cache = ChunkReplayCache(factory, byte_budget=1000)  # fits 1, spills on 2nd
+    # fits 1, spills on 2nd
+    cache = ChunkReplayCache(factory, byte_budget=1000, spill_dir=None)
     assert len(list(cache)) == 4  # spill must not drop output chunks
     assert cache.spilled and cache.cached_bytes == 0
     assert len(list(cache)) == 4
     assert pulls["n"] == 2  # over budget → every pass re-streams
     assert cache.replay_passes == 0
+
+
+def test_replay_cache_spills_to_disk_and_replays(tmp_path):
+    """Disk spill (the default): over budget → overflow chunks pickle to a
+    spool and every later pass replays memory prefix + disk tail in order —
+    decode still paid exactly once, eviction parity with the in-memory path
+    (same chunks, same order, equal contents)."""
+    from photon_tpu.obs.metrics import registry
+
+    chunks = _fake_chunks(k=4, rows=100)  # 800 B per chunk
+    pulls = {"n": 0}
+
+    def factory():
+        pulls["n"] += 1
+        yield from chunks
+
+    spilled0 = registry().counter("replay_cache_spilled_bytes_total").value
+    cache = ChunkReplayCache(
+        factory, byte_budget=1000, spill_dir=str(tmp_path)
+    )
+    first = list(cache)
+    assert pulls["n"] == 1 and cache.spilled
+    assert cache.cached_bytes <= 1000  # memory prefix stays under budget
+    assert cache.spilled_bytes == 3 * 800  # chunks 2..4 on disk
+    spilled1 = registry().counter("replay_cache_spilled_bytes_total").value
+    assert spilled1 - spilled0 == 3 * 800
+    second = list(cache)
+    assert pulls["n"] == 1  # decode paid exactly once despite the spill
+    assert cache.replay_passes == 1
+    assert [c.index for c in second] == [c.index for c in first]
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(np.asarray(a.batch), np.asarray(b.batch))
+    cache.close()
+    assert not any(tmp_path.glob("spool-*.pkl"))  # close deletes the spool
+
+
+def test_replay_cache_disk_spill_abandoned_pass_retries(tmp_path):
+    """A pass abandoned after spilling deletes its spool; the next pass
+    re-streams and rebuilds memory + disk, then replays."""
+    pulls = {"n": 0}
+
+    def factory():
+        pulls["n"] += 1
+        yield from _fake_chunks(k=4, rows=100)
+
+    cache = ChunkReplayCache(factory, byte_budget=1000, spill_dir=str(tmp_path))
+    it = iter(cache)
+    for _ in range(3):
+        next(it)  # past the spill point
+    it.close()
+    assert not any(tmp_path.glob("spool-*.pkl"))
+    assert len(list(cache)) == 4 and pulls["n"] == 2
+    assert len(list(cache)) == 4 and pulls["n"] == 2  # replays now
 
 
 def test_replay_cache_abandoned_pass_restreams():

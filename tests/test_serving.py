@@ -250,6 +250,176 @@ def test_store_cold_and_unknown_entities_resolve_minus_one():
 
 
 # ---------------------------------------------------------------------------
+# Hot/cold for PROJECTED (subspace) random-effect tables (satellite)
+# ---------------------------------------------------------------------------
+
+D_PROJ = 6
+PROJ_ENTITIES = 24  # entity 23 is block -1 (cold: no model, scores 0)
+
+
+def make_proj_model(n_entities=PROJ_ENTITIES, d_full=D_PROJ):
+    """Fixed effect + one projected RE coordinate: 2 blocks with distinct
+    column subspaces, entities alternating blocks, last entity modeless."""
+    prng = np.random.default_rng(7)
+    col_maps = [np.array([0, 1, 2], np.int32), np.array([2, 3, 4, 5], np.int32)]
+    inv_maps = []
+    for cmap in col_maps:
+        inv = np.full(d_full, -1, np.int32)
+        inv[cmap] = np.arange(len(cmap), dtype=np.int32)
+        inv_maps.append(inv)
+    entity_block = np.array(
+        [e % 2 for e in range(n_entities)], np.int32
+    )
+    entity_block[-1] = -1
+    entity_row = np.zeros(n_entities, np.int32)
+    counts = [0, 0]
+    for e in range(n_entities):
+        b = int(entity_block[e])
+        if b >= 0:
+            entity_row[e] = counts[b]
+            counts[b] += 1
+    block_coefs = [
+        prng.normal(size=(counts[b], len(col_maps[b]))).astype(np.float32)
+        for b in range(2)
+    ]
+    from photon_tpu.models.game import ProjectedRandomEffectModel
+
+    proj = ProjectedRandomEffectModel(
+        block_coefs=[jnp.asarray(b) for b in block_coefs],
+        col_maps=[jnp.asarray(c) for c in col_maps],
+        inv_maps=[jnp.asarray(i) for i in inv_maps],
+        entity_block=jnp.asarray(entity_block),
+        entity_row=jnp.asarray(entity_row),
+        d_full=d_full, re_type="userId", feature_shard="shardB",
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+    w_fix = np.linspace(-1, 1, D_FIX).astype(np.float32)
+    return GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(np.asarray(w_fix)), TaskType.LOGISTIC_REGRESSION
+            ),
+            "shardA",
+        ),
+        "per_user_proj": proj,
+    })
+
+
+def _proj_batch(ids, xa, xb):
+    n = len(ids)
+    return GameBatch(
+        label=jnp.zeros(n, jnp.float32),
+        offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+        features={"shardA": jnp.asarray(xa), "shardB": jnp.asarray(xb)},
+        entity_ids={"userId": jnp.asarray(np.asarray(ids), jnp.int32)},
+    )
+
+
+def test_store_projected_pins_when_budget_covers_blocks():
+    import jax
+
+    model = make_proj_model()
+    store = HotColdEntityStore(
+        model, {"userId": make_entity_index(PROJ_ENTITIES)}, hot_bytes=1 << 30
+    )
+    proj = store.proj_group("userId")
+    assert proj is not None and proj.pinned
+    assert "userId" in store.entity_re_types
+    # Pinned: entity ids pass through as indices; the scoring model carries
+    # the exact master tables and maps.
+    ids = store.resolve("userId", ["user3", "nope", "user23"])
+    np.testing.assert_array_equal(ids, [3, -1, 23])
+    served = store.scoring_model().models["per_user_proj"]
+    src = model.models["per_user_proj"]
+    for b in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(served.block_coefs[b]), np.asarray(src.block_coefs[b])
+        )
+    np.testing.assert_array_equal(
+        np.asarray(served.entity_block), np.asarray(src.entity_block)
+    )
+
+
+def test_store_projected_hot_cold_parity_demotion_and_zero_retraces():
+    """Satellite: projected tables under a byte budget. Every micro-batch
+    promotes its entities into per-block hot pools, demoted entities' map
+    entries go cold (-1), and the served scores stay BIT-equal to the
+    full-table batch path — with zero scorer retraces across promotions,
+    demotions, and scoring-model swaps."""
+    import jax
+
+    from photon_tpu.obs.metrics import registry
+
+    model = make_proj_model()
+    ref_tr = GameTransformer(jax.device_put(model))
+    store = HotColdEntityStore(
+        model, {"userId": make_entity_index(PROJ_ENTITIES)},
+        hot_bytes=1, min_hot_rows=4,
+    )
+    proj = store.proj_group("userId")
+    coord = proj.coords[0]
+    assert not proj.pinned and coord.capacities == [4, 4]
+    stats = store.stats()["userId"]
+    assert stats["projected"] and not stats["pinned"]
+    store.warm_uploads(4)
+
+    demos0 = registry().counter(
+        "serve_store_demotions_total", re_type="userId"
+    ).value
+    tr = GameTransformer(store.scoring_model())
+    prng = np.random.default_rng(11)
+    warm_traces = None
+    # Cycle every entity (incl. the modeless one and an unknown key) in
+    # batches of 4: 24 uniques through 4+4 hot rows forces demotion waves.
+    keys = [f"user{e}" for e in range(PROJ_ENTITIES)] + ["nope"] * 4
+    for start in range(0, len(keys), 4):
+        group_keys = keys[start:start + 4]
+        ids = store.resolve("userId", group_keys)
+        true_ids = [
+            int(k[4:]) if k.startswith("user") else -1 for k in group_keys
+        ]
+        np.testing.assert_array_equal(ids, true_ids)
+        xa = prng.normal(size=(4, D_FIX)).astype(np.float32)
+        xb = prng.normal(size=(4, D_PROJ)).astype(np.float32)
+        batch = _proj_batch(ids, xa, xb)
+        got = np.asarray(tr.transform(batch, model=store.scoring_model()))
+        want = np.asarray(ref_tr.transform(_proj_batch(true_ids, xa, xb)))
+        np.testing.assert_array_equal(got, want)  # atol=0: same program
+        if warm_traces is None:
+            warm_traces = tr.trace_count
+    assert tr.trace_count == warm_traces  # swaps/promotions never retrace
+
+    demos1 = registry().counter(
+        "serve_store_demotions_total", re_type="userId"
+    ).value
+    assert demos1 - demos0 > 0
+    # Hot pools hold at most capacity entities; every non-resident entity's
+    # device map entry was scattered cold (-1) on demotion.
+    dev_blk = np.asarray(coord.dev_entity_block)
+    resident = set()
+    for lru in coord.lrus:
+        resident.update(lru.resident)
+    for e in range(PROJ_ENTITIES):
+        if int(coord.entity_block[e]) < 0 or e not in resident:
+            assert dev_blk[e] == -1, e
+        else:
+            assert dev_blk[e] == int(coord.entity_block[e]), e
+
+    # Re-promote long-demoted entities: parity still holds (round-trip
+    # through demotion loses nothing; rows re-gather from the host master).
+    ids = store.resolve("userId", ["user0", "user1", "user2", "user3"])
+    xa = prng.normal(size=(4, D_FIX)).astype(np.float32)
+    xb = prng.normal(size=(4, D_PROJ)).astype(np.float32)
+    got = np.asarray(
+        tr.transform(_proj_batch(ids, xa, xb), model=store.scoring_model())
+    )
+    want = np.asarray(ref_tr.transform(_proj_batch([0, 1, 2, 3], xa, xb)))
+    np.testing.assert_array_equal(got, want)
+    assert tr.trace_count == warm_traces
+
+
+# ---------------------------------------------------------------------------
 # Engine: parity, zero retraces, reload
 # ---------------------------------------------------------------------------
 
