@@ -1474,6 +1474,307 @@ def run_fault_soak(n_requests: int = 3000, d: int = 32, E: int = 512):
     }
 
 
+def run_exhaustion_soak():
+    """Resource-exhaustion soak (ISSUE 10): drive device OOM, disk-full,
+    and host memory pressure through every allocating layer via the
+    ``oom``/``enospc``/``rss`` fault kinds and prove the containment
+    policy — model artifacts > training progress > observability.
+
+    Phases:
+
+    A. OOC RE training at the budget floor with OOM injected at the device
+       upload edge and ENOSPC under ``--re-spill-dir``: the run completes
+       and coefficients are BIT-IDENTICAL to the unconstrained fault-free
+       run (containment changes residency, never values).
+    B. Replay cache: ENOSPC on the spool falls back to legacy re-stream
+       with exact chunk parity and no spool file left; a torn spool between
+       passes recovers to the identical chunk sequence.
+    C. Checkpoints: disk-full mid-sweep prunes older steps (keep-last-K)
+       and retries — the newest step survives, no tmp files; a telemetry
+       report hitting ENOSPC degrades to a counted drop, never an error.
+    D. Serving: OOM injected at warm-up and at the entity-store upload is
+       contained (gc + retry) — ZERO caller-visible errors and scores
+       bit-identical to a fault-free engine.
+    E. RSS pressure: soft tightens pipeline depth and admission caps; hard
+       raises a clean actionable HostMemoryPressureError, not a SIGKILL.
+
+    Ends with a recursive scan of the work dir: no ``*.tmp`` or partial
+    spool artifacts may survive any phase.
+    """
+    import glob as _glob
+    import os
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from photon_tpu.algorithm.random_effect import RandomEffectCoordinate
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.index_map import EntityIndex
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.io.pipeline import ChunkReplayCache
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.obs.report import write_run_report
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.factory import OptimizerSpec
+    from photon_tpu.serve import ScoreRequest, ServeConfig, ServingEngine
+    from photon_tpu.types import OptimizerType, TaskType
+    from photon_tpu.utils import faults, resources
+    from photon_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    work = tempfile.mkdtemp(prefix="photon-exhaustion-")
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(41)
+
+    def plan(*rules, seed=41):
+        faults.reset()
+        faults.configure(faults.FaultPlan.from_obj(
+            {"seed": seed, "rules": list(rules)}))
+
+    try:
+        # ----- Phase A: OOC RE training parity under OOM + spill ENOSPC --
+        E, D = 48, 5
+        counts = rng.integers(6, 14, size=E)
+        eids = np.repeat(np.arange(E, dtype=np.int32), counts)
+        n = eids.size
+        X = rng.normal(size=(n, D)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        w = np.ones(n, np.float32)
+        cfg = RandomEffectDataConfig(
+            re_type="userId", feature_shard="re", n_buckets=2,
+            shape_bucketing=True,
+        )
+        batch = GameBatch(
+            label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+            weight=jnp.asarray(w), features={"re": jnp.asarray(X)},
+            entity_ids={"userId": jnp.asarray(eids)},
+        )
+
+        def train_re(budget, spill_dir):
+            coord = RandomEffectCoordinate(
+                "per_user",
+                build_random_effect_dataset(eids, X, y, w, E, cfg),
+                TaskType.LOGISTIC_REGRESSION,
+                GLMObjective(loss=LogisticLoss, l2_weight=0.5),
+                optimizer_spec=OptimizerSpec(
+                    optimizer=OptimizerType.NEWTON, max_iter=20, tol=1e-9),
+                device_budget_bytes=budget,
+                device_spill_dir=spill_dir,
+            )
+            model = None
+            for it in range(3):
+                coord.begin_cd_pass(it)
+                model, _stats = coord.train(batch, None, model)
+            return np.asarray(model.coefficients)
+
+        _progress("exhaustion A: OOC RE training, OOM at upload + "
+                  "ENOSPC under the spill dir")
+        faults.reset()
+        ref = train_re(None, None)  # unconstrained, fault-free
+        # ``at`` indices spaced >1 apart so the single contained retry
+        # never immediately re-fires; spill ENOSPC falls back to host RAM.
+        plan(
+            {"site": "re_store.upload", "kind": "oom",
+             "at": [0, 6, 15, 29], "max_count": 4},
+            {"site": "re_store.spill", "kind": "enospc", "p": 0.3},
+        )
+        got = train_re(1, os.path.join(work, "re-spill"))
+        oom_injected = dict(faults.injector().counts())
+        faults.reset()
+        assert np.array_equal(ref, got), \
+            "OOC coefficients under exhaustion differ from clean run"
+        spill_fallbacks = registry().find("re_spill_fallbacks_total")
+        assert spill_fallbacks is not None and spill_fallbacks.value >= 1
+
+        # ----- Phase B: replay spool ENOSPC fallback + torn spool --------
+        _progress("exhaustion B: replay spool ENOSPC fallback + torn-spool "
+                  "recovery")
+        items = [rng.normal(size=256).astype(np.float32) for _ in range(8)]
+
+        def cache_for(tag):
+            return ChunkReplayCache(
+                lambda: iter(items), byte_budget=2 * items[0].nbytes + 1,
+                nbytes=lambda a: a.nbytes,
+                spill_dir=os.path.join(work, tag),
+            )
+
+        def parity(seq):
+            assert len(seq) == len(items)
+            for a, b in zip(seq, items):
+                assert np.array_equal(np.asarray(a), b)
+
+        plan({"site": "spool.write", "kind": "enospc", "at": [0]})
+        c1 = cache_for("spill-enospc")
+        parity(list(c1))  # failure mid-pass: training still sees all chunks
+        parity(list(c1))  # sticky legacy re-stream
+        faults.reset()
+        assert c1.spilled and c1.source_passes == 2
+        assert _glob.glob(os.path.join(work, "spill-enospc", "*.pkl")) == []
+
+        c2 = cache_for("spill-torn")
+        parity(list(c2))
+        spools = _glob.glob(os.path.join(work, "spill-torn", "*.pkl"))
+        assert len(spools) == 1
+        with open(spools[0], "rb+") as f:
+            f.truncate(max(1, os.path.getsize(spools[0]) // 2))
+        parity(list(c2))  # replay hits the tear, recovers exactly
+        parity(list(c2))  # cache rebuilt clean
+        torn = registry().find("replay_spool_torn_total")
+        assert torn is not None and torn.value >= 1
+        c2.close()  # end-of-training: drops the rebuilt (live) spool
+
+        # ----- Phase C: checkpoint keep-last prune-retry + telemetry -----
+        _progress("exhaustion C: checkpoint ENOSPC prune-and-retry + "
+                  "telemetry drop")
+        ckpt = os.path.join(work, "ckpt")
+        plan({"site": "checkpoint.io", "kind": "enospc", "at": [4],
+              "max_count": 1})
+        for step in range(6):
+            save_checkpoint(ckpt, dict(w=np.full(4, float(step))), step,
+                            keep_last=2)
+        faults.reset()
+        state, step = load_checkpoint(ckpt)
+        assert step == 5 and np.array_equal(
+            np.asarray(state["w"]), np.full(4, 5.0))
+        steps = [p for p in os.listdir(ckpt) if p.startswith("step_")]
+        assert len(steps) <= 2, f"keep-last-2 violated: {steps}"
+
+        report = os.path.join(work, "report.jsonl")
+        plan({"site": "telemetry.write", "kind": "enospc", "at": [0]})
+        write_run_report(report, [dict(record="meta", phase="C")])  # dropped
+        assert not os.path.exists(report)
+        write_run_report(report, [dict(record="meta", phase="C")])  # retried
+        faults.reset()
+        assert os.path.exists(report)
+        drops = registry().find("telemetry_write_failures_total")
+        assert drops is not None and drops.value >= 1
+
+        # ----- Phase D: serving under warm-up + upload OOM ---------------
+        _progress("exhaustion D: serving with OOM at warm-up and "
+                  "entity-store upload")
+        SE, SD, SN = 256, 16, 200
+        eidx = EntityIndex()
+        for e in range(SE):
+            eidx.intern(f"u{e}")
+        model = GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(rng.normal(size=SD).astype(np.float32)),
+                    TaskType.LOGISTIC_REGRESSION,
+                ),
+                "s",
+            ),
+            "per_user": RandomEffectModel(
+                (rng.normal(size=(SE, SD)) / 4).astype(np.float32),
+                "userId", "s", TaskType.LOGISTIC_REGRESSION,
+            ),
+        })
+        SX = rng.normal(size=(SN, SD)).astype(np.float32)
+        susers = rng.integers(0, SE, size=SN)
+
+        def score_all(engine):
+            out = []
+            errors = 0
+            for i in range(SN):
+                try:
+                    out.append(engine.submit(ScoreRequest(
+                        {"s": SX[i]}, {"userId": f"u{susers[i]}"}
+                    )).result(timeout=120))
+                except Exception:  # noqa: BLE001 — any escape is a failure
+                    errors += 1
+            return np.asarray(out), errors
+
+        # hot_bytes small enough that the RE table can NOT be pinned whole:
+        # resolve misses must flow through the contained upload path.
+        config = ServeConfig(max_batch_size=16, max_delay_ms=1.0,
+                             queue_cap=SN, hot_bytes=1 << 12)
+        plan(
+            {"site": "serve.warm_up", "kind": "oom", "at": [0],
+             "max_count": 1},
+            {"site": "serve.store_upload", "kind": "oom",
+             "at": [0, 3, 8, 14], "max_count": 4},
+        )
+        engine = ServingEngine(model, entity_indexes={"userId": eidx},
+                               config=config)
+        faulted_scores, caller_errors = score_all(engine)
+        serve_injected = dict(faults.injector().counts())
+        engine.close()
+        faults.reset()
+        clean_engine = ServingEngine(model, entity_indexes={"userId": eidx},
+                                     config=config)
+        clean_scores, clean_errors = score_all(clean_engine)
+        clean_engine.close()
+        assert caller_errors == 0, \
+            f"{caller_errors} caller-visible errors under device OOM"
+        assert clean_errors == 0
+        assert np.array_equal(faulted_scores, clean_scores), \
+            "scores under OOM containment differ from the clean engine"
+        assert serve_injected.get("serve.store_upload", 0) >= 1
+
+        # ----- Phase E: host RSS pressure --------------------------------
+        _progress("exhaustion E: RSS watchdog soft tightening + clean hard "
+                  "failure")
+        resources.stop_watchdog()
+        wd = resources.start_watchdog(limit_bytes=1 << 62, interval_s=3600)
+        plan({"site": "rss.sample", "kind": "rss", "p": 1.0,
+              "message": "soft"})
+        wd.sample()
+        assert resources.memory_pressure()
+        assert resources.tightened_depth(4) == 1
+        assert resources.tightened_cap(64) == 32
+        plan({"site": "rss.sample", "kind": "rss", "p": 1.0,
+              "message": "hard"})
+        wd.sample()
+        hard_clean = False
+        try:
+            resources.check_memory("exhaustion soak")
+        except resources.HostMemoryPressureError as exc:
+            hard_clean = "OOM-killer" in str(exc)
+        assert hard_clean, "hard pressure must raise the actionable error"
+        faults.reset()
+        resources.stop_watchdog()
+
+        # ----- Final: no partial artifacts anywhere ----------------------
+        leftovers = [
+            p for pat in ("**/*.tmp", "**/spool-*.pkl")
+            for p in _glob.glob(os.path.join(work, pat), recursive=True)
+        ]
+        assert leftovers == [], f"partial artifacts survived: {leftovers}"
+
+        return {
+            "metric": "exhaustion_soak",
+            "unit": "phases",
+            "value": 5,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "re_parity": True,
+            "re_faults_injected": oom_injected,
+            "serve_caller_errors": caller_errors,
+            "serve_parity": True,
+            "serve_faults_injected": serve_injected,
+            "spill_fallbacks": int(spill_fallbacks.value),
+            "spool_torn_recoveries": int(torn.value),
+            "telemetry_drops": int(drops.value),
+            "checkpoint_keep_last_ok": True,
+            "rss_hard_clean_failure": hard_clean,
+            "partial_artifacts": 0,
+        }
+    finally:
+        faults.reset()
+        resources.stop_watchdog()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def run_rollout_soak(E: int = 16, n_train: int = 512):
     """Continuous-rollout soak: the full generation lifecycle in-process.
 
@@ -2518,6 +2819,13 @@ def main():
         # Serving soak under injected store faults + reload churn: zero
         # caller-visible crashes, breaker trips + recovers; CPU-measurable.
         print(json.dumps(run_fault_soak()))
+        return
+    if "--exhaustion-soak" in sys.argv:
+        # Device OOM + disk-full + host memory pressure injected through
+        # every allocating layer: run completes, zero caller errors,
+        # coefficients and scores bit-identical to the unconstrained run,
+        # no partial artifacts on disk; CPU-measurable.
+        print(json.dumps(run_exhaustion_soak()))
         return
     if "--rollout-soak" in sys.argv:
         # Full continuous-rollout lifecycle under live traffic: train →

@@ -107,6 +107,7 @@ class CoordinateDescent:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
         checkpoint_tag: Optional[str] = None,
+        checkpoint_keep_last: Optional[int] = None,
         emitter=None,  # utils.events.EventEmitter; optimization-log events
         profile: bool = True,
     ) -> CoordinateDescentResult:
@@ -127,6 +128,14 @@ class CoordinateDescent:
         ``checkpoint_every`` iterations and training RESUMES from the latest
         checkpoint found there — mid-training recovery the reference lacks
         (its warm start is model-only, SURVEY.md §5).
+        ``checkpoint_keep_last`` caps how many step files survive (the
+        writer prunes the oldest after each publish; on a full disk it also
+        prunes before retrying). A save that still fails with ENOSPC after
+        the writer's prune-and-retry degrades to a logged warning plus
+        ``checkpoint_write_failures_total`` and TRAINING CONTINUES — a full
+        checkpoint disk must not kill a run that can still produce its
+        final model (degradation priority: the finished artifact outranks
+        intermediate durability).
         """
         n = batch.n
         dtype = batch.offset.dtype
@@ -313,6 +322,7 @@ class CoordinateDescent:
             registry().counter("cd_iterations_total").inc()
 
             def _save_checkpoint(it=it):
+                from photon_tpu.utils import resources
                 from photon_tpu.utils.checkpoint import save_checkpoint
 
                 with span("cd/checkpoint_save"):
@@ -325,22 +335,39 @@ class CoordinateDescent:
                         if getattr(coord, "export_active_state", None)
                         is not None
                     }
-                    save_checkpoint(
-                        checkpoint_dir,
-                        dict(
-                            models=models,
-                            scores=scores,
-                            total_scores=total_scores,
-                            metric_history=metric_history,
-                            best_metric=best_metric,
-                            best_model=best_model,
-                            tracker=tracker,
-                            wall_times=wall_times,
-                            active_state=active_state,
-                            tag=checkpoint_tag or ",".join(self.update_sequence),
-                        ),
-                        it,
-                    )
+                    try:
+                        save_checkpoint(
+                            checkpoint_dir,
+                            dict(
+                                models=models,
+                                scores=scores,
+                                total_scores=total_scores,
+                                metric_history=metric_history,
+                                best_metric=best_metric,
+                                best_model=best_model,
+                                tracker=tracker,
+                                wall_times=wall_times,
+                                active_state=active_state,
+                                tag=checkpoint_tag or ",".join(self.update_sequence),
+                            ),
+                            it,
+                            keep_last=checkpoint_keep_last,
+                        )
+                    except OSError as exc:
+                        # The writer already pruned + retried; a persistent
+                        # full disk degrades to lost intermediate durability,
+                        # not a lost run.
+                        if not resources.is_enospc(exc):
+                            raise
+                        registry().counter(
+                            "checkpoint_write_failures_total"
+                        ).inc()
+                        logger.warning(
+                            "checkpoint save at pass %d failed even after "
+                            "pruning (disk full under %s); continuing "
+                            "WITHOUT a checkpoint this pass: %s",
+                            it, checkpoint_dir, exc,
+                        )
 
             saved = False
             if checkpoint_dir is not None and (it + 1) % checkpoint_every == 0:
@@ -365,6 +392,19 @@ class CoordinateDescent:
                     it, signum,
                 )
                 raise GracefulShutdown(signum)
+
+            # Same cooperative boundary handles host memory pressure: at the
+            # watchdog's hard level, checkpoint what we have and raise a
+            # clean actionable error instead of waiting for the OOM-killer's
+            # unexplained SIGKILL.
+            from photon_tpu.utils import resources
+
+            try:
+                resources.check_memory(f"coordinate_descent pass {it}")
+            except resources.HostMemoryPressureError:
+                if checkpoint_dir is not None and not saved:
+                    _save_checkpoint()
+                raise
 
         final = GameModel(dict(models))
         if best_model is None:

@@ -47,8 +47,11 @@ import numpy as np
 from photon_tpu.data.random_effect import EntityBlock
 from photon_tpu.data.residency import ByteBudgetLru
 from photon_tpu.obs.metrics import registry
+from photon_tpu.utils import faults, resources
 
 logger = logging.getLogger("photon_tpu")
+
+_SPILL_GUARD = resources.DiskBudgetGuard("re_store.spill")
 
 _BLOCK_FIELDS = (
     "entity_idx",
@@ -76,8 +79,22 @@ def host_entity_block(
         arr = np.asarray(getattr(block, name))
         if spill_dir is not None:
             path = os.path.join(spill_dir, f"block{index:05d}_{name}.npy")
-            np.save(path, arr)
-            arr = np.load(path, mmap_mode="r")
+            try:
+                _SPILL_GUARD.check()  # ``enospc`` rules for --re-spill-dir
+                np.save(path, arr)
+                arr = np.load(path, mmap_mode="r")
+            except OSError as exc:
+                # Disk full under the spill dir: keep this array in host RAM
+                # instead (values identical, RSS higher) and remove the
+                # partial .npy so it cannot strand space or be mmapped torn.
+                _SPILL_GUARD.record(exc)
+                _SPILL_GUARD.cleanup(path)
+                registry().counter("re_spill_fallbacks_total").inc()
+                logger.warning(
+                    "re_store spill of block %d field %s to %s failed; "
+                    "keeping it in host memory: %s", index, name, spill_dir,
+                    exc,
+                )
         fields[name] = arr
     return EntityBlock(col_map=None, **fields)
 
@@ -131,6 +148,7 @@ class ReDeviceStore:
         self.total_cost = int(sum(self.block_cost))
         self.budget = int(budget_bytes)
         max_cost = max(self.block_cost, default=0)
+        self._max_cost = max_cost
         self.effective_budget = max(self.budget, max_cost)
         if self.effective_budget > self.budget:
             logger.warning(
@@ -237,7 +255,9 @@ class ReDeviceStore:
             self.upload_hits += 1
             reg.counter("re_store_upload_hits_total", **self._labels).inc()
         else:
-            dev_block = jax.device_put(host_block)
+            dev_block = self._upload_contained(
+                lambda: jax.device_put(host_block), f"block {key}"
+            )
             nbytes = block_data_bytes(host_block)
             self.uploads += 1
             self.upload_bytes += nbytes
@@ -250,9 +270,76 @@ class ReDeviceStore:
             if cacheable:
                 with self._cond:
                     self._resident[key] = dev_block
-        w0 = jax.device_put(np.ascontiguousarray(w0_host))
+        w0 = self._upload_contained(
+            lambda: jax.device_put(np.ascontiguousarray(w0_host)),
+            f"w0 for block {key}",
+        )
         self._publish()
         return dev_block, w0
+
+    def _upload_contained(self, upload, what: str):
+        """Run a device upload with OOM containment: on RESOURCE_EXHAUSTED,
+        evict every unprotected resident block, halve the effective budget
+        toward the floor (the largest single block — admitting less than
+        that would deadlock), release dropped buffers, and retry. The
+        XLA allocator can legitimately fail before our budget does — it
+        serves fragmented HBM, compiled executables, and other coordinates'
+        working sets too — and the out-of-core path is value-identical at
+        any budget, so shrinking is bit-safe. A hard
+        :class:`~photon_tpu.utils.resources.DeviceMemoryError` fires only
+        when the floor itself cannot fit."""
+        import gc
+
+        floor_retry = True
+        while True:
+            try:
+                faults.check("re_store.upload")  # ``oom`` injection site
+                return upload()
+            except Exception as exc:
+                if not resources.is_device_oom(exc):
+                    raise
+                shrunk = self._evict_harder_and_shrink()
+                if not shrunk:
+                    if not floor_retry:
+                        raise resources.DeviceMemoryError(
+                            f"re_store[{self.coordinate_id}]: device OOM "
+                            f"uploading {what} at the floor budget "
+                            f"({self._max_cost} B — the largest single "
+                            "block). Containment already evicted the whole "
+                            "working set; shrink the block geometry "
+                            "(--re-max-block-entities) or add device memory."
+                        ) from exc
+                    floor_retry = False
+                logger.warning(
+                    "re_store[%s]: device OOM uploading %s; evicted working "
+                    "set, effective budget now %d B, retrying: %s",
+                    self.coordinate_id, what, self.effective_budget, exc,
+                )
+                gc.collect()
+
+    def _evict_harder_and_shrink(self) -> bool:
+        """OOM response: drop every unprotected resident block and halve
+        the effective budget (floored at the largest single block). Returns
+        False when the budget was already at the floor — the caller gets
+        exactly one more eviction-only retry before failing hard."""
+        with self._cond:
+            for victim in list(self.lru.resident):
+                if victim in self._protected:
+                    continue
+                if self.lru.evict(victim):
+                    self._resident.pop(victim, None)
+            shrunk = self.effective_budget > self._max_cost
+            if shrunk:
+                self.effective_budget = max(
+                    self._max_cost, self.effective_budget // 2
+                )
+                self.lru.budget = self.effective_budget
+                registry().counter(
+                    "re_device_budget_shrinks_total", **self._labels
+                ).inc()
+            self._cond.notify_all()
+        self._publish()
+        return shrunk
 
     def release(self, key, cacheable: bool) -> None:
         """d2h worker: the solve's results are materialized on host; the

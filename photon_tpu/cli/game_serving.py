@@ -544,6 +544,11 @@ def _run_inprocess(args):
 
 def run(args):
     setup_logging(args.verbose)
+    from photon_tpu.utils import resources
+
+    # Host RSS watchdog: under memory pressure the micro-batcher's
+    # admission cap tightens (shed by backpressure, not by OOM-killer).
+    resources.start_watchdog()
     if args.workers and args.workers > 0:
         _run_multiprocess(args)
     else:

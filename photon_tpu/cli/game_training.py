@@ -134,6 +134,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "automatically when state exists)")
     p.add_argument("--checkpoint-every", type=int, default=1,
                    help="checkpoint cadence in CD iterations")
+    p.add_argument("--checkpoint-keep-last", type=int, default=None,
+                   help="keep only the newest K step files per checkpoint "
+                        "dir (pruned after each save; also pruned before "
+                        "the disk-full retry). Default: keep everything, "
+                        "or PHOTON_TPU_CHECKPOINT_KEEP_LAST")
     p.add_argument("--resume", action="store_true",
                    help="resume an interrupted run from --checkpoint-dir: "
                         "requires checkpoint state to exist (auto-resume "
@@ -171,8 +176,14 @@ def build_parser() -> argparse.ArgumentParser:
 def run(args) -> Dict:
     setup_logging(args.verbose)
     from photon_tpu.obs import begin_run, finalize_run_report
+    from photon_tpu.utils import resources
 
     begin_run()  # fresh spans / metrics / phase records for THIS run
+    # Host RSS watchdog: inert without a detectable limit (cgroup or
+    # PHOTON_TPU_RSS_LIMIT_BYTES); under pressure it tightens pipeline queue
+    # depths / replay budgets, and the CD pass boundary fails cleanly at the
+    # hard level instead of catching the OOM-killer's SIGKILL.
+    resources.start_watchdog()
     task = task_of(args)
     from photon_tpu.utils.events import EventEmitter, setup_event
 
@@ -417,6 +428,7 @@ def run(args) -> Dict:
                 initial_model=warm,
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
+                checkpoint_keep_last=args.checkpoint_keep_last,
                 emitter=emitter,
             )
     except GracefulShutdown as exc:

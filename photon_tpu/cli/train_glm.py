@@ -167,6 +167,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume an interrupted λ sweep from --checkpoint-dir "
                         "(requires checkpoint state to exist; auto-resume "
                         "merely uses it when present)")
+    p.add_argument("--checkpoint-keep-last", type=int, default=None,
+                   help="keep only the newest K λ-step files (pruned after "
+                        "each save; also pruned before the disk-full "
+                        "retry). NB a resumed sweep replays pruned λs. "
+                        "Default: keep everything, or "
+                        "PHOTON_TPU_CHECKPOINT_KEEP_LAST")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -292,6 +298,12 @@ def run(args) -> Dict:
     from photon_tpu.obs import begin_run, finalize_run_report, span
 
     begin_run()  # fresh spans / metrics / phase records for THIS run
+    from photon_tpu.utils import resources as _resources
+
+    # Host RSS watchdog: inert without a detectable limit; under pressure
+    # pipeline depths tighten, and the λ boundary below fails cleanly at the
+    # hard level instead of catching the OOM-killer's SIGKILL.
+    _resources.start_watchdog()
     if getattr(args, "re_active_set", False):
         logging.getLogger(__name__).warning(
             "--re-active-set is a no-op for the single-GLM driver (no "
@@ -503,25 +515,42 @@ def run(args) -> Dict:
             )
         )
         if ckpt_dir:
+            from photon_tpu.utils import resources
             from photon_tpu.utils.checkpoint import save_checkpoint
 
             # Replay handles (_objective/_spec/_w0) are live closures, not
             # persistable — strip them; everything else (including the
             # OptimizeResult diagnostics) round-trips through the manifest.
-            save_checkpoint(
-                ckpt_dir,
-                dict(
-                    tag=ckpt_tag,
-                    w=w,
-                    models=[
-                        {k: v for k, v in m.items() if not k.startswith("_")}
-                        for m in models
-                    ],
-                    solver_diags=solver_diags,
-                    solver_walls=solver_walls,
-                ),
-                lam_idx,
-            )
+            try:
+                save_checkpoint(
+                    ckpt_dir,
+                    dict(
+                        tag=ckpt_tag,
+                        w=w,
+                        models=[
+                            {k: v for k, v in m.items() if not k.startswith("_")}
+                            for m in models
+                        ],
+                        solver_diags=solver_diags,
+                        solver_walls=solver_walls,
+                    ),
+                    lam_idx,
+                    keep_last=args.checkpoint_keep_last,
+                )
+            except OSError as exc:
+                # The writer already pruned + retried. A disk that stays
+                # full costs resumability, not the sweep: the final model
+                # summary still gets written at the end.
+                if not resources.is_enospc(exc):
+                    raise
+                from photon_tpu.obs.metrics import registry
+
+                registry().counter("checkpoint_write_failures_total").inc()
+                logging.getLogger("photon_tpu.train_glm").warning(
+                    "λ-sweep checkpoint at λ=%g failed even after pruning "
+                    "(disk full under %s); continuing WITHOUT a checkpoint "
+                    "for this λ: %s", lam, ckpt_dir, exc,
+                )
         signum = shutdown_requested()
         if signum is not None:
             logging.getLogger("photon_tpu.train_glm").warning(
@@ -531,6 +560,12 @@ def run(args) -> Dict:
                 "train_glm", path=args.telemetry_out, emitter=emitter
             )
             raise GracefulShutdown(signum)
+        # Same cooperative boundary handles hard host memory pressure: the
+        # finished λ steps are already durable (when --checkpoint-dir is
+        # set), so failing HERE is clean and resumable.
+        from photon_tpu.utils import resources as _resources
+
+        _resources.check_memory(f"train_glm λ={lam:g}")
     stage = DriverStage.TRAINED
 
     # Validation + model selection (Driver.computeAndLogModelMetrics:353 +

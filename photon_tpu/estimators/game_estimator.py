@@ -301,6 +301,7 @@ class GameEstimator:
         initial_model: Optional[GameModel] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
+        checkpoint_keep_last: Optional[int] = None,
         emitter=None,  # utils.events.EventEmitter for optimization-log events
     ) -> List[GameResult]:
         """Train one GameModel per optimization configuration, warm-starting
@@ -346,6 +347,7 @@ class GameEstimator:
                         else f"{checkpoint_dir}/cfg_{cfg_idx}"
                     ),
                     checkpoint_every=checkpoint_every,
+                    checkpoint_keep_last=checkpoint_keep_last,
                     # Fingerprint the λ-sweep point: resuming against a
                     # changed grid/sequence fails loudly instead of serving a
                     # stale model from the same cfg index.

@@ -56,7 +56,7 @@ import numpy as np
 
 from photon_tpu.obs.metrics import registry
 from photon_tpu.obs.trace import current_span_path, record_span, tracer
-from photon_tpu.utils import faults
+from photon_tpu.utils import faults, resources
 from photon_tpu.utils.timed import PipelineStats, StageStats, record_pipeline
 
 logger = logging.getLogger("photon_tpu")
@@ -143,11 +143,21 @@ class _SkipBudget:
             error=f"{type(exc).__name__}: {exc}",
             ts=time.time(),
         )
+        # A failing sidecar append must never mask the ORIGINAL chunk error
+        # the caller is handling — dead letters are observability, the
+        # bottom of the degradation priority. Degrade to a counted drop.
+        guard = resources.DiskBudgetGuard("deadletter.write")
         try:
             with self._lock:
                 with open(self.dead_letter_path, "a") as f:
+                    guard.check()  # ``enospc``/error rules for the sidecar
                     f.write(json.dumps(record) + "\n")
-        except OSError:
+        except OSError as exc2:
+            guard.record(exc2)
+            try:
+                registry().counter("dead_letter_write_failures_total").inc()
+            except Exception:
+                pass
             logger.exception(
                 "could not append dead-letter record to %s",
                 self.dead_letter_path,
@@ -449,6 +459,10 @@ def _run_staged(
 
         return run
 
+    # Each queue slot pins one decoded host chunk, so under host memory
+    # pressure the depth is the cheapest RSS to give back: drop to
+    # single-buffering for this pipeline run (trade overlap for survival).
+    depth = resources.tightened_depth(depth)
     queues = [queue.Queue(maxsize=depth) for _ in range(len(stages) + 1)]
     threads = [
         threading.Thread(
@@ -867,6 +881,7 @@ class ChunkReplayCache:
         self.byte_budget = int(byte_budget)
         self._nbytes = nbytes
         self._spill_dir = spill_dir
+        self._guard = resources.DiskBudgetGuard("spool.write")
         self._spool_path: Optional[str] = None
         self._spool_count = 0
         self._spool_seq = 0
@@ -904,10 +919,57 @@ class ChunkReplayCache:
             for _ in range(self._spool_count):
                 yield pickle.load(fh)
 
+    def _spill_failed(self, exc: OSError, spool) -> None:
+        """ENOSPC (or any OSError) on the spool mid-spill: fall back to the
+        legacy re-stream path instead of propagating into the training loop.
+        Closes and deletes the partial spool file, stops caching, and
+        disables disk spill for this cache's lifetime — the current pass
+        keeps yielding straight from the source, and later passes re-stream
+        (decode is re-paid, training is not interrupted)."""
+        if spool is not None:
+            try:
+                spool.close()
+            except OSError:
+                pass
+        self._guard.record(exc)
+        try:
+            registry().counter("replay_spill_fallbacks_total").inc()
+        except Exception:
+            pass
+        logger.warning(
+            "replay-cache spool write failed under %s; falling back to "
+            "re-streaming from source (decode re-paid each pass): %s",
+            self._spill_dir, exc,
+        )
+        self._reset_cache()
+        self._spill_dir = None
+        self.spilled = True
+
     def close(self) -> None:
         """Drop the cache and delete any spool file."""
         self._complete = False
         self._reset_cache()
+
+    def _recover_torn_spool(self, exc: Exception, already_yielded: int):
+        """A replay pass hit a torn/partial spool file (truncated pickle,
+        deleted file, bit rot). Decode order is deterministic, so recovery
+        is exact: drop the cache (deleting the bad spool), re-stream the
+        SOURCE, and skip the chunks this pass already yielded from the
+        memory prefix + intact spool head — the consumer sees the same
+        chunk sequence it would have without the tear."""
+        reg = registry()
+        reg.counter("replay_spool_torn_total").inc()
+        logger.warning(
+            "torn replay spool %s after %d chunk(s); re-streaming this pass "
+            "from source: %s", self._spool_path, already_yielded, exc,
+        )
+        self._complete = False
+        self._reset_cache()
+        self.source_passes += 1
+        reg.counter("replay_cache_source_passes_total").inc()
+        for i, chunk in enumerate(self._factory()):
+            if i >= already_yielded:
+                yield chunk
 
     def __iter__(self) -> Iterator[BatchChunk]:
         reg = registry()
@@ -916,7 +978,19 @@ class ChunkReplayCache:
             reg.counter("replay_cache_replay_passes_total").inc()
             yield from self._chunks
             if self._spool_count:
-                yield from self._read_spool()
+                yielded = len(self._chunks)
+                spool_iter = self._read_spool()
+                while True:
+                    try:
+                        chunk = next(spool_iter)
+                    except StopIteration:
+                        break
+                    except (OSError, EOFError, pickle.UnpicklingError,
+                            ValueError) as exc:
+                        yield from self._recover_torn_spool(exc, yielded)
+                        return
+                    yield chunk
+                    yielded += 1
             return
         self.source_passes += 1
         reg.counter("replay_cache_source_passes_total").inc()
@@ -931,28 +1005,47 @@ class ChunkReplayCache:
             for chunk in self._factory():
                 if caching:
                     cost = self._nbytes(chunk)
-                    if spool is None and self.cached_bytes + cost > self.byte_budget:
+                    # Host memory pressure tightens the replay budget: stop
+                    # growing the in-RAM prefix early and spill (or fall
+                    # back) even though the nominal byte budget has room.
+                    over = (self.cached_bytes + cost > self.byte_budget
+                            or resources.memory_pressure())
+                    if spool is None and over:
                         if self._spill_dir is None:
                             self.spilled, caching = True, False
                             self._reset_cache()
                             reg.counter("replay_cache_spills_total").inc()
                         else:
-                            spool = self._open_spool()
-                            self.spilled = True
-                            reg.counter("replay_cache_spills_total").inc()
+                            try:
+                                self._guard.check()
+                                spool = self._open_spool()
+                            except OSError as exc:
+                                self._spill_failed(exc, spool)
+                                spool, caching = None, False
+                            else:
+                                self.spilled = True
+                                reg.counter("replay_cache_spills_total").inc()
                     if caching:
                         if spool is None:
                             self._chunks.append(chunk)
                             self.cached_bytes += cost
                         else:
-                            pickle.dump(
-                                chunk, spool, protocol=pickle.HIGHEST_PROTOCOL
-                            )
-                            self._spool_count += 1
-                            self.spilled_bytes += cost
-                            reg.counter(
-                                "replay_cache_spilled_bytes_total"
-                            ).inc(cost)
+                            try:
+                                self._guard.check()
+                                pickle.dump(
+                                    chunk, spool,
+                                    protocol=pickle.HIGHEST_PROTOCOL,
+                                )
+                                spool.flush()
+                            except OSError as exc:
+                                self._spill_failed(exc, spool)
+                                spool, caching = None, False
+                            else:
+                                self._spool_count += 1
+                                self.spilled_bytes += cost
+                                reg.counter(
+                                    "replay_cache_spilled_bytes_total"
+                                ).inc(cost)
                 yield chunk
             finished = True
         finally:

@@ -29,6 +29,8 @@ import sys
 import threading
 from typing import Any, Dict, List, Optional
 
+from photon_tpu.utils import resources
+
 # v2 (2026-08): histogram ``stats`` gained p50/p95/p99 keys (bounded
 # deterministic reservoir, obs/metrics.py). Backward compatible for readers:
 # ``stats`` was already typed as an open dict, no field was removed or
@@ -303,27 +305,49 @@ def write_run_report(
     ``<path>.1`` and, if the new snapshot alone exceeds the budget, the
     oldest span records drop first (then coordinate-descent rows, then
     phases) — meta/env/metric summary records are always kept, so a
-    long soak degrades telemetry granularity, never observability."""
+    long soak degrades telemetry granularity, never observability.
+
+    Telemetry sits at the bottom of the degradation priority: an OSError on
+    the final write (disk full at finalize, say) drops the report with a
+    warning and a ``telemetry_write_failures_total`` count instead of
+    crashing the driver after training already succeeded. The partial tmp
+    file is removed either way."""
     if max_bytes is None:
         env = os.environ.get("PHOTON_TPU_TELEMETRY_MAX_BYTES")
         if env:
             max_bytes = int(env)
-    parent = os.path.dirname(os.path.abspath(path))
-    os.makedirs(parent, exist_ok=True)
+    guard = resources.DiskBudgetGuard("telemetry.write")
     lines = [json.dumps(rec, sort_keys=True) + "\n" for rec in records]
     with _write_lock:
-        if max_bytes is not None and max_bytes > 0:
-            kinds = [rec.get("record") for rec in records]
-            lines = _budget_lines(lines, kinds, max_bytes)
-            if os.path.exists(path):
-                try:
-                    os.replace(path, path + ".1")
-                except OSError:
-                    pass  # rotation is best-effort; the write is not
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.writelines(lines)
-        os.replace(tmp, path)
+        try:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            if max_bytes is not None and max_bytes > 0:
+                kinds = [rec.get("record") for rec in records]
+                lines = _budget_lines(lines, kinds, max_bytes)
+                if os.path.exists(path):
+                    try:
+                        os.replace(path, path + ".1")
+                    except OSError:
+                        pass  # rotation is best-effort; the write is not
+            with open(tmp, "w") as f:
+                guard.check()  # ``enospc``/error rules for telemetry.write
+                f.writelines(lines)
+            os.replace(tmp, path)
+        except OSError as exc:
+            guard.record(exc)
+            guard.cleanup(tmp)
+            try:
+                from photon_tpu.obs.metrics import registry
+
+                registry().counter("telemetry_write_failures_total").inc()
+            except Exception:
+                pass
+            logging.getLogger("photon_tpu").warning(
+                "dropping run report %s (%d records): write failed: %s",
+                path, len(records), exc,
+            )
 
 
 def finalize_run_report(

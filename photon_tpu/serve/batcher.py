@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from photon_tpu.obs.metrics import registry
 from photon_tpu.obs.trace import tracer
+from photon_tpu.utils import resources
 
 
 class BackpressureError(RuntimeError):
@@ -126,10 +127,14 @@ class MicroBatcher:
         now = time.monotonic()
         fut: Future = Future()
         victim: Optional[_Pending] = None
+        # Host memory pressure tightens the admission cap (half at soft,
+        # quarter at hard): each queued request pins host buffers, and
+        # shedding by backpressure beats dying by OOM-killer.
+        cap = resources.tightened_cap(self.queue_cap)
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"batcher {self.name!r} is closed")
-            if len(self._pending) >= self.queue_cap:
+            if len(self._pending) >= cap:
                 if priority != "batch":
                     for i in range(len(self._pending) - 1, -1, -1):
                         if self._pending[i].priority == "batch":
@@ -143,7 +148,7 @@ class MicroBatcher:
                     reg.counter("serve_requests_shed_total").inc()
                     raise BackpressureError(
                         f"serve queue depth {len(self._pending)} at cap "
-                        f"{self.queue_cap}; request shed"
+                        f"{cap}; request shed"
                     )
             self._pending.append(
                 _Pending(

@@ -66,7 +66,7 @@ from photon_tpu.serve.admission import (
 )
 from photon_tpu.serve.batcher import MicroBatcher, ScoreRequest
 from photon_tpu.serve.store import HotColdEntityStore
-from photon_tpu.utils import faults
+from photon_tpu.utils import faults, resources
 
 logger = logging.getLogger("photon_tpu")
 
@@ -204,8 +204,18 @@ class ServingEngine:
 
     def _build_state(self, model: GameModel, version: str) -> _State:
         """Store + transformer + FULL warm-up for one model generation.
-        Runs entirely off the scoring lock so reloads never stall traffic."""
-        with tracer().span("serve/warm_up"):
+        Runs entirely off the scoring lock so reloads never stall traffic.
+
+        Warm-up is the engine's biggest allocation burst (every hot table
+        plus every solve-cache executable for the batch grid), so a device
+        OOM here gets contained: release the partial build, collect dropped
+        buffers, retry once. The retry rebuilds from the host master — no
+        caller ever sees a half-warmed generation. A second OOM raises a
+        clean :class:`~photon_tpu.utils.resources.DeviceMemoryError` (the
+        reload path keeps serving the old generation)."""
+
+        def build() -> _State:
+            faults.check("serve.warm_up", label=version)
             store = HotColdEntityStore(
                 model,
                 self._entity_indexes,
@@ -218,7 +228,23 @@ class ServingEngine:
             template = self._template_batch(store)
             traces = transformer.warm_up(template, bucket_grid(self.max_batch))
             registry().gauge("serve_warmup_traces").set(traces)
-        return _State(store, transformer, version, transformer.trace_count)
+            return _State(store, transformer, version, transformer.trace_count)
+
+        with tracer().span("serve/warm_up"):
+            try:
+                return resources.oom_retry(
+                    build, site="serve.warm_up",
+                    counter="serve_warmup_oom_retries_total",
+                )
+            except Exception as exc:
+                if not resources.is_device_oom(exc):
+                    raise
+                raise resources.DeviceMemoryError(
+                    f"serve engine: device OOM warming up model version "
+                    f"{version!r} even after retry. Shrink --hot-bytes or "
+                    "--max-batch, evict serving versions, or add device "
+                    "memory."
+                ) from exc
 
     def _template_batch(self, store: HotColdEntityStore) -> GameBatch:
         """1-row inert batch with the production layout: dense zero features

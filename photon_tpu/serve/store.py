@@ -40,6 +40,7 @@ serve/engine.py. The store itself is single-writer: the engine serializes
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -53,9 +54,47 @@ from photon_tpu.models.game import (
     RandomEffectModel,
 )
 from photon_tpu.obs.metrics import registry
-from photon_tpu.utils import faults
+from photon_tpu.utils import faults, resources
+
+logger = logging.getLogger("photon_tpu")
 
 _scatter_rows = None
+
+
+def _oom_contained(re_type: str, fn):
+    """Run a device scatter/upload with OOM containment: on
+    RESOURCE_EXHAUSTED, release dropped table buffers (the scatters are
+    functional — the superseded tables are garbage the allocator may still
+    hold) and retry once, counting
+    ``serve_store_oom_evictions_total{re_type}``. ``fn`` must be
+    idempotent. A second OOM becomes a clean
+    :class:`~photon_tpu.utils.resources.DeviceMemoryError`."""
+    import gc
+
+    try:
+        return fn()
+    except Exception as exc:
+        if not resources.is_device_oom(exc):
+            raise
+        registry().counter(
+            "serve_store_oom_evictions_total", re_type=re_type
+        ).inc()
+        logger.warning(
+            "serve store: device OOM uploading %s rows; collecting dropped "
+            "buffers and retrying once: %s", re_type, exc,
+        )
+        gc.collect()
+        try:
+            return fn()
+        except Exception as exc2:
+            if not resources.is_device_oom(exc2):
+                raise
+            raise resources.DeviceMemoryError(
+                f"serve store: device OOM uploading {re_type} rows even "
+                "after releasing dropped buffers. Shrink --hot-bytes / the "
+                "hot-row capacity or the max batch size, or add device "
+                "memory."
+            ) from exc2
 
 
 def _scatter(table, idx, rows):
@@ -441,7 +480,9 @@ class HotColdEntityStore:
             reg.counter("serve_store_misses_total", re_type=re_type).inc(
                 len(misses)
             )
-            self._upload(group, misses)
+            # Idempotent: a pure scatter of host rows into already-claimed
+            # slots, so the OOM containment may safely run it twice.
+            _oom_contained(re_type, lambda: self._upload(group, misses))
         return slots
 
     def _claim_slot(self, group: _ReGroup, entity: int, in_use: set) -> int:
@@ -481,7 +522,13 @@ class HotColdEntityStore:
         for coord in proj.coords:
             if self._coord_pinned(coord):
                 continue
-            faults.check("serve.store_upload", label=proj.re_type)
+            # Injected ``oom`` rules here take the same contained
+            # gc-and-retry path a real allocator failure would.
+            _oom_contained(
+                proj.re_type,
+                lambda: faults.check("serve.store_upload",
+                                     label=proj.re_type),
+            )
             # Entities of this batch grouped by their host block, for the
             # per-block in-use protection sets.
             in_use_by_block: Dict[int, set] = {}
@@ -520,8 +567,16 @@ class HotColdEntityStore:
                 reg.counter(
                     "serve_store_misses_total", re_type=proj.re_type
                 ).inc(len(misses))
-                self._upload_projected_rows(coord, misses, rows_of)
-            self._rewrite_proj_maps(proj, coord, misses, rows_of)
+                _oom_contained(
+                    proj.re_type,
+                    lambda: self._upload_projected_rows(
+                        coord, misses, rows_of
+                    ),
+                )
+            _oom_contained(
+                proj.re_type,
+                lambda: self._rewrite_proj_maps(proj, coord, misses, rows_of),
+            )
 
     def _claim_proj_slot(
         self, proj: _ProjGroup, coord: _ProjCoord, block: int, entity: int,
@@ -562,9 +617,11 @@ class HotColdEntityStore:
         demotion victims go cold (-1)."""
         # Drain IN PLACE: the SlotLru on_demote closures captured this list
         # object at build time — rebinding would orphan it and every later
-        # victim would silently keep its stale (hot) map entry.
+        # victim would silently keep its stale (hot) map entry. The clear
+        # happens only after both scatters land, so an OOM-contained retry
+        # of this whole function still sees every victim (no demotions can
+        # occur in between — nothing here claims slots).
         victims = list(coord.demoted)
-        coord.demoted.clear()
         m = len(misses) + len(victims)
         m_b = bucket_dim(m)
         E = coord.entity_block.shape[0]
@@ -578,6 +635,7 @@ class HotColdEntityStore:
             row[len(victims) + j] = rows_of[e]
         coord.dev_entity_block = _scatter(coord.dev_entity_block, idx, blk)
         coord.dev_entity_row = _scatter(coord.dev_entity_row, idx, row)
+        coord.demoted.clear()
 
     def warm_uploads(self, max_batch: int) -> None:
         """Compile the upload scatters for every miss-count bucket ≤

@@ -40,12 +40,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.utils import faults
+from photon_tpu.utils import faults, resources
 
 logger = logging.getLogger(__name__)
 
 _LATEST = "LATEST"
 _FORMAT_VERSION = 2
+
+CHECKPOINT_KEEP_LAST_ENV = "PHOTON_TPU_CHECKPOINT_KEEP_LAST"
+
+# Checkpoints sit at the top of the degradation priority (they ARE the model
+# artifact), so their ENOSPC policy is the aggressive one: prune, retry.
+_DISK_GUARD = resources.DiskBudgetGuard("checkpoint.io")
 
 
 class LegacyCheckpointError(ValueError):
@@ -236,11 +242,75 @@ def _decode(spec: Any, z) -> Any:
 # ---------------------------------------------------------------------------
 
 
-def save_checkpoint(directory: str, state: Any, step: int) -> str:
+def _write_step(path: str, manifest: dict, arrays: list) -> None:
+    """Atomically write one step file. Any failure — including the injected
+    ``enospc`` at the ``checkpoint.io`` hook — removes the partial tmp file
+    before propagating: a failed save must not eat the very space a retry
+    (or a later step) needs."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            # ``enospc`` rules fire here, after the tmp exists but before
+            # its data does — the worst place a real full disk bites.
+            _DISK_GUARD.check()
+            np.savez(
+                f,
+                __manifest__=np.frombuffer(
+                    json.dumps(manifest).encode(), np.uint8
+                ),
+                **{f"leaf_{i}": a for i, a in enumerate(arrays)},
+            )
+            # Durability before visibility: without the fsync, a machine
+            # crash (not just process preemption) can publish a rename whose
+            # DATA blocks never hit disk — a torn file at the final name.
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic publish — no torn checkpoints
+    except BaseException:
+        _DISK_GUARD.cleanup(tmp)
+        raise
+
+
+def prune_checkpoints(directory: str, keep_last: Optional[int]) -> int:
+    """Delete the oldest ``step_<N>.npz`` files so at most ``keep_last``
+    remain (newest kept). Best-effort; returns how many were removed and
+    counts them in ``checkpoint_pruned_total``."""
+    if keep_last is None or keep_last < 1:
+        return 0
+    steps = _scan_steps(directory)
+    removed = 0
+    for s in steps[:-keep_last] if len(steps) > keep_last else []:
+        try:
+            os.unlink(os.path.join(directory, f"step_{s}.npz"))
+            removed += 1
+        except OSError:
+            pass
+    if removed:
+        try:
+            from photon_tpu.obs import registry
+
+            registry().counter("checkpoint_pruned_total").inc(removed)
+        except Exception:
+            pass
+    return removed
+
+
+def save_checkpoint(
+    directory: str, state: Any, step: int, keep_last: Optional[int] = None
+) -> str:
     """Persist ``state`` (containers + arrays + registered framework
-    objects) as step ``step``. Returns the file path."""
+    objects) as step ``step``. Returns the file path.
+
+    ``keep_last`` (or the ``PHOTON_TPU_CHECKPOINT_KEEP_LAST`` env var when
+    None) caps how many step files survive after a successful publish.
+    ENOSPC during the write prunes down to the single newest older step and
+    retries once before giving up — checkpoints outrank everything else in
+    the degradation priority, so they reclaim their own disk first."""
     if not _REGISTRY:
         _register_builtin_nodes()
+    if keep_last is None:
+        env = os.environ.get(CHECKPOINT_KEEP_LAST_ENV, "").strip()
+        keep_last = int(env) if env else None
     os.makedirs(directory, exist_ok=True)
     arrays: list = []
     manifest = {"version": _FORMAT_VERSION, "root": _encode(state, arrays)}
@@ -262,27 +332,28 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
                 f"injected torn checkpoint at {path}"
             )
         raise faults.exception_for(rule, "checkpoint.save")
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(
-            f,
-            __manifest__=np.frombuffer(
-                json.dumps(manifest).encode(), np.uint8
-            ),
-            **{f"leaf_{i}": a for i, a in enumerate(arrays)},
+    try:
+        _write_step(path, manifest, arrays)
+    except OSError as exc:
+        if not _DISK_GUARD.record(exc):
+            raise
+        pruned = prune_checkpoints(directory, keep_last=1)
+        logger.warning(
+            "disk full writing checkpoint step %d; pruned %d older step(s) "
+            "and retrying once: %s", step, pruned, exc,
         )
-        # Durability before visibility: without the fsync, a machine crash
-        # (not just process preemption) can publish a rename whose DATA
-        # blocks never hit disk — a torn file at the final name.
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)  # atomic publish — no torn checkpoints on preemption
+        _write_step(path, manifest, arrays)  # second failure propagates
     latest_tmp = os.path.join(directory, _LATEST + ".tmp")
-    with open(latest_tmp, "w") as f:
-        f.write(str(step))
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(latest_tmp, os.path.join(directory, _LATEST))
+    try:
+        with open(latest_tmp, "w") as f:
+            f.write(str(step))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(latest_tmp, os.path.join(directory, _LATEST))
+    except BaseException:
+        _DISK_GUARD.cleanup(latest_tmp)
+        raise
+    prune_checkpoints(directory, keep_last)
     # Post-publish hook: the ``ci.sh faults`` kill-and-resume smoke SIGKILLs
     # here, right after a step becomes durable — the worst legitimate moment.
     faults.check("checkpoint.after_save")

@@ -36,6 +36,18 @@ call indices (``at``), optionally bounded by ``max_count``. Kinds:
   hit disk) and raises.
 - ``kill``       — ``SIGKILL`` the current process at the hook (used by the
   ``ci.sh faults`` kill-and-resume smoke).
+- ``enospc``     — raise :class:`EnospcInjectedFault` (an ``OSError`` with
+  ``errno == ENOSPC``), simulating a full disk at a writer site
+  (``checkpoint.io``/``telemetry.write``/``spool.write``/
+  ``deadletter.write``/``re_store.spill``).
+- ``oom``        — raise :class:`DeviceOomInjectedFault` (a ``RuntimeError``
+  whose message contains ``RESOURCE_EXHAUSTED``), simulating a device
+  allocator failure at an upload site (``re_store.upload``/
+  ``serve.store_upload``/``serve.warm_up``).
+- ``rss``        — only acts at the ``rss.sample`` site, where the host
+  memory watchdog (:mod:`photon_tpu.utils.resources`) interprets it as a
+  simulated pressure reading (``message`` containing ``"hard"`` → hard
+  pressure, else soft). A bare :func:`check` ignores it, like ``nan``.
 
 Every injection increments ``faults_injected_total{site,kind}`` in the
 metrics registry, so fault counts land in the run report. With no plan
@@ -59,7 +71,8 @@ logger = logging.getLogger(__name__)
 
 FAULT_PLAN_ENV = "PHOTON_TPU_FAULT_PLAN"
 
-KINDS = ("transient", "permanent", "nan", "torn", "kill")
+KINDS = ("transient", "permanent", "nan", "torn", "kill", "enospc", "oom",
+         "rss")
 
 
 class InjectedFault(Exception):
@@ -73,6 +86,27 @@ class TransientInjectedFault(InjectedFault, OSError):
 
 class PermanentInjectedFault(InjectedFault, RuntimeError):
     """Non-retryable injected failure."""
+
+
+class EnospcInjectedFault(InjectedFault, OSError):
+    """Injected disk-full failure — an ``OSError`` carrying
+    ``errno == ENOSPC`` so every writer's real ENOSPC policy (and
+    :func:`photon_tpu.utils.resources.is_enospc`) handles it unchanged."""
+
+    def __init__(self, message: str):
+        import errno as _errno
+
+        OSError.__init__(self, _errno.ENOSPC, message)
+
+
+class DeviceOomInjectedFault(InjectedFault, RuntimeError):
+    """Injected device allocator failure. The message embeds
+    ``RESOURCE_EXHAUSTED`` so code that classifies real ``XlaRuntimeError``
+    OOMs by substring (:func:`photon_tpu.utils.resources.is_device_oom`)
+    takes the same containment path for injected ones."""
+
+    def __init__(self, message: str):
+        RuntimeError.__init__(self, f"RESOURCE_EXHAUSTED: {message}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -209,6 +243,10 @@ class FaultInjector:
 def exception_for(rule: FaultRule, site: str) -> InjectedFault:
     if rule.kind == "permanent":
         return PermanentInjectedFault(f"{rule.message} [{site}]")
+    if rule.kind == "enospc":
+        return EnospcInjectedFault(f"{rule.message} [{site}]")
+    if rule.kind == "oom":
+        return DeviceOomInjectedFault(f"{rule.message} [{site}]")
     return TransientInjectedFault(f"{rule.message} [{site}]")
 
 
@@ -272,8 +310,10 @@ def check(site: str, label: Optional[str] = None) -> None:
     if rule.kind == "kill":
         logger.warning("fault plan: SIGKILL self at %s", site)
         os.kill(os.getpid(), signal.SIGKILL)
-    if rule.kind == "nan":
-        return  # nan rules only act through poison(); a bare check ignores them
+    if rule.kind in ("nan", "rss"):
+        # nan rules only act through poison(), rss rules only through the
+        # RSS watchdog's sampler; a bare check ignores both.
+        return
     raise exception_for(rule, site)
 
 
@@ -290,6 +330,8 @@ def poison(site: str, array, label: Optional[str] = None):
     if rule.kind == "kill":
         logger.warning("fault plan: SIGKILL self at %s", site)
         os.kill(os.getpid(), signal.SIGKILL)
+    if rule.kind == "rss":
+        return array  # rss rules only act through the watchdog sampler
     if rule.kind != "nan":
         raise exception_for(rule, site)
     if isinstance(array, np.ndarray):
@@ -305,6 +347,8 @@ def poison(site: str, array, label: Optional[str] = None):
 
 __all__ = [
     "FAULT_PLAN_ENV",
+    "DeviceOomInjectedFault",
+    "EnospcInjectedFault",
     "FaultInjector",
     "FaultPlan",
     "FaultRule",
