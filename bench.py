@@ -2071,6 +2071,422 @@ def run_rollout_soak(E: int = 16, n_train: int = 512):
     }
 
 
+def run_streaming_soak(E: int = 2000, hot_entities: int = 16):
+    """Streaming-freshness soak: the full feedback → micro-generation loop
+    live and in-process.
+
+    gen-1 serves while two producer threads score a HOT SLICE of the
+    entity space (``hot_entities``/``E`` ≤ 1%) and report labels straight
+    back through ``engine.feedback_label``. The spool seals segments on a
+    sub-second cadence, a background :class:`StreamingUpdater` turns them
+    into per-entity DELTA micro-generations, and the unchanged rollout
+    watcher shadows + promotes each one — all under uninterrupted load.
+
+    Acceptance (ISSUE 11):
+      - ≥3 micro-generations publish → shadow → promote under live load;
+      - ZERO caller-visible errors, ZERO retraces after warm-up;
+      - label→promoted staleness p95 < 60 s
+        (``model_staleness_s_hist``);
+      - every delta manifest: ≤1% of entities changed AND <5% of the
+        full-model bytes (asserted from manifest ``totalBytes``);
+      - every shadow sample bit-exact vs pinned scoring of the promoted
+        generation;
+      - SIGKILLing the updater mid-cycle (real subprocess, real signal)
+        and restarting yields a model bit-identical to an uninterrupted
+        run of the same segments.
+    """
+    import os
+    import subprocess
+    import tempfile
+    import threading
+
+    from photon_tpu.cli.game_serving import RolloutOptions, _reload_watcher
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.io.model_io import (
+        delta_info,
+        gate_and_publish,
+        load_game_model,
+        load_generation_manifest,
+        load_resolved_game_model,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.serve import ScoreRequest, ServeConfig, ServingEngine
+    from photon_tpu.stream.spool import FeedbackSpool, SpoolConfig
+    from photon_tpu.stream.updater import (
+        StreamingUpdater,
+        StreamingUpdaterConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    d_fix, d_re = 5, 3
+    task = TaskType.LOGISTIC_REGRESSION
+    coord_configs = [
+        FixedEffectCoordinateConfig("global", "global"),
+        RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+    ]
+
+    def make_game(w_fix, w_re):
+        return GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(np.asarray(w_fix, np.float32)), task
+                ),
+                "global",
+            ),
+            "per_user": RandomEffectModel(
+                np.asarray(w_re, np.float32), "userId", "per_user", task
+            ),
+        })
+
+    def make_root(path, n_entities, seed):
+        """Publish a deterministic gen-1 (no training — the soak measures
+        the streaming loop, not the batch fit) + serving artifacts."""
+        r = np.random.default_rng(seed)
+        w_fix = r.normal(size=d_fix).astype(np.float32)
+        w_re = r.normal(size=(n_entities, d_re)).astype(np.float32)
+        imaps = {
+            "global": IndexMap.build([f"g{j}" for j in range(d_fix)]),
+            "per_user": IndexMap.build([f"r{j}" for j in range(d_re)]),
+        }
+        eidx = EntityIndex()
+        for e in range(n_entities):
+            eidx.intern(f"user{e}")
+        for shard, imap in imaps.items():
+            imap.save(os.path.join(path, f"index-map-{shard}.json"))
+        eidx.save(os.path.join(path, "entity-index-userId.json"))
+        g1 = os.path.join(path, "gen-1")
+        save_game_model(make_game(w_fix, w_re), g1, imaps,
+                        {"userId": eidx}, sparsity_threshold=0.0)
+        write_generation_manifest(g1, parent=None)
+        assert gate_and_publish(path, "gen-1").ok
+        return imaps, eidx
+
+    def updater_for(path, imaps, eidx, cadence_s=0.2, min_records=24):
+        return StreamingUpdater(
+            StreamingUpdaterConfig(
+                publish_root=path,
+                spool_dir=os.path.join(path, "spool"),
+                task=task,
+                coordinate_configs=coord_configs,
+                update_sequence=["global", "per_user"],
+                cadence_s=cadence_s,
+                min_records=min_records,
+                locked_coordinates=["global"],
+                delta_artifacts=True,
+                num_iterations=1,
+                # Tiny random micro-batches legitimately move per-entity
+                # norms a lot; drift gating is exercised by --rollout-soak.
+                norm_drift_bound=1e4,
+            ),
+            imaps, {"userId": eidx},
+        )
+
+    def basename(v):
+        return os.path.basename(str(v).rstrip("/"))
+
+    def wait_for(pred, timeout, msg):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"streaming soak: timed out waiting for {msg}")
+
+    root = tempfile.mkdtemp(prefix="streaming-soak-")
+    sdir = os.path.join(root, "spool")
+    _progress("streaming soak: publishing gen-1, starting serve + updater")
+    imaps, eidx = make_root(root, E, seed=71)
+    g1 = os.path.join(root, "gen-1")
+    full_bytes = load_generation_manifest(g1)["totalBytes"]
+
+    engine = ServingEngine(
+        load_game_model(g1, imaps, {"userId": eidx}, to_device=False),
+        entity_indexes={"userId": eidx}, index_maps=imaps,
+        config=ServeConfig(max_batch_size=8, max_delay_ms=1.0,
+                           hot_bytes=1 << 30, max_versions=3,
+                           shadow_fraction=1.0),
+        model_version=g1,
+    )
+    spool = FeedbackSpool(sdir, SpoolConfig(segment_max_records=24,
+                                            segment_max_age_s=0.25))
+    spool.start_auto_flush()
+    engine.attach_feedback(spool)
+
+    opts = RolloutOptions(shadow_fraction=1.0, shadow_quota=8,
+                          divergence_bound=1e6, breaker_trip_bound=1000,
+                          max_reload_attempts=3, backoff_s=0.05)
+    stop = threading.Event()
+    watcher = threading.Thread(target=_reload_watcher,
+                               args=(engine, root, 0.05, stop, opts),
+                               daemon=True)
+    watcher.start()
+    updater = updater_for(root, imaps, eidx)
+    upd_thread = threading.Thread(target=updater.run_forever, daemon=True)
+    upd_thread.start()
+
+    # Live traffic on the hot slice only — so every micro-generation's
+    # changed-entity set stays within the ≤1% delta bar by construction.
+    Xf = np.random.default_rng(72).normal(size=(64, d_fix)).astype(np.float32)
+    Xr = np.random.default_rng(73).normal(size=(64, d_re)).astype(np.float32)
+    Xf[:, 0] = 1.0
+    Xr[:, 0] = 1.0
+    ok = errors = 0
+    lock = threading.Lock()
+    done = threading.Event()
+
+    def producer(seed):
+        nonlocal ok, errors
+        r = np.random.default_rng(seed)
+        k = 0
+        while not done.is_set():
+            i = int(r.integers(0, 64))
+            u = int(r.integers(0, hot_entities))
+            uid = f"{seed}-{k}:{i}:{u}"  # unique join key; encodes (i, u)
+            k += 1
+            try:
+                engine.submit(ScoreRequest(
+                    {"global": Xf[i], "per_user": Xr[i]},
+                    {"userId": f"user{u}"},
+                    uid=uid,
+                )).result(timeout=120)
+                # The label arrives "later" from the caller's side — here
+                # immediately, so staleness measures the loop, not the sim.
+                engine.feedback_label(uid, float(r.integers(0, 2)))
+                with lock:
+                    ok += 1
+            except Exception:  # noqa: BLE001 — any escape is a soak failure
+                with lock:
+                    errors += 1
+            time.sleep(0.002)
+
+    producers = [threading.Thread(target=producer, args=(seed,), daemon=True)
+                 for seed in (201, 202)]
+    t0 = time.perf_counter()
+    for t in producers:
+        t.start()
+
+    # Phase 1: ≥3 micro-generations must publish → shadow → promote while
+    # the producers hammer the engine.
+    _progress("streaming soak: waiting for 3 live promotions")
+    promoted = []
+
+    def note_promotion():
+        v = basename(engine.model_version)
+        if not promoted or promoted[-1] != v:
+            promoted.append(v)
+        return len(promoted) >= 4  # gen-1 + 3 micro-generations
+
+    wait_for(note_promotion, 300, "3 micro-generation promotions")
+
+    # Phase 2: one controlled final publish for the shadow bit-exactness
+    # bar (the updater thread is stopped so exactly ONE candidate shadows,
+    # and its samples are still resident when we read them).
+    _progress("streaming soak: controlled final publish for shadow parity")
+    updater.stop()
+    upd_thread.join(timeout=120)
+    assert not upd_thread.is_alive(), "updater thread failed to stop"
+    final = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        spool.flush()
+        res = updater.run_once()
+        if res is not None and res.published:
+            final = res
+            break
+        time.sleep(0.2)
+    assert final is not None, "no final micro-generation published"
+    wait_for(lambda: basename(engine.model_version) == final.generation,
+             120, f"promotion of {final.generation}")
+    promoted.append(final.generation)
+
+    samples = engine.shadow_samples()
+    assert len(samples) >= opts.shadow_quota, len(samples)
+    for s in samples:
+        _, i, u = s["uid"].split(":")
+        i, u = int(i), int(u)
+        direct = np.float32(engine.score(
+            {"global": Xf[i], "per_user": Xr[i]}, {"userId": f"user{u}"},
+            model_version=final.generation,
+        ))
+        assert np.float32(s["shadow"]) == direct, (s, direct)
+
+    done.set()
+    for t in producers:
+        t.join(timeout=10)
+    wall = time.perf_counter() - t0
+    retraces = engine.retraces_since_warmup
+    stop.set()
+    watcher.join(timeout=10)
+    engine.close()  # closes the attached spool too
+
+    # Delta-efficiency bar, from the manifests of the actual lineage: every
+    # micro-generation changed ≤1% of entities and wrote <5% of the
+    # full-model bytes.
+    deltas = []
+    cur = os.path.join(root, final.generation)
+    while True:
+        man = load_generation_manifest(cur) or {}
+        info = delta_info(cur)
+        if info:
+            changed = int(info["changedEntities"].get("userId", 0))
+            assert changed <= 0.01 * E, (cur, changed)
+            assert man["totalBytes"] < 0.05 * full_bytes, (
+                cur, man["totalBytes"], full_bytes)
+            deltas.append({
+                "generation": basename(cur),
+                "changed_entities": changed,
+                "bytes": man["totalBytes"],
+            })
+        parent = man.get("parent")
+        if not parent:
+            break
+        cur = os.path.join(root, parent)
+    assert len(deltas) >= 3, f"only {len(deltas)} delta publishes: {deltas}"
+
+    stale = registry().histogram("model_staleness_s_hist").percentiles()
+    p95 = stale["p95"]
+    assert np.isfinite(p95) and p95 < 60.0, f"staleness p95 {p95}s ≥ 60s"
+    assert errors == 0, f"{errors} caller-visible errors during soak"
+    assert retraces == 0, f"{retraces} retraces after warm-up"
+
+    # Phase 3: SIGKILL the updater mid-cycle in a real subprocess; the
+    # restarted updater must land a model bit-identical to an uninterrupted
+    # run over the same segments (manifest-as-cursor: no double apply).
+    _progress("streaming soak: SIGKILL crash-resume bit-equivalence")
+
+    def seg_records(n, entities, seed):
+        r = np.random.default_rng(seed)
+        return [{
+            "ts": 1000.0 + i,
+            "uid": f"u{seed}-{i}",
+            "tenant": None,
+            "features": {
+                "global": [float(v) for v in r.normal(size=d_fix)],
+                "per_user": [float(v) for v in r.normal(size=d_re)],
+            },
+            "entityIds": {"userId": f"user{entities[i % len(entities)]}"},
+            "offset": 0.0,
+            "score": 0.0,
+            "modelVersion": "gen-1",
+            "label": float(i % 2),
+            "labelTs": 2000.0 + i,
+        } for i in range(n)]
+
+    def write_segment(spool_dir, seq, records):
+        os.makedirs(spool_dir, exist_ok=True)
+        with open(os.path.join(spool_dir, f"segment-{seq:08d}.jsonl"),
+                  "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+
+    def re_coefs(gen_dir, imaps2, eidx2):
+        model = load_resolved_game_model(gen_dir, imaps2,
+                                         {"userId": eidx2}, to_device=False)
+        return np.asarray(model.models["per_user"].coefficients)
+
+    e2 = 8
+    runs = {}
+    for tag in ("a", "b"):
+        rt = tempfile.mkdtemp(prefix=f"streaming-crash-{tag}-")
+        sd = os.path.join(rt, "spool")
+        imaps2, eidx2 = make_root(rt, e2, seed=91)  # same seed: same gen-1
+        for seq, seed, entities in ((1, 151, [0, 1]), (2, 152, [2]),
+                                    (3, 153, [3, 4]), (4, 154, [5])):
+            write_segment(sd, seq, seg_records(6, entities, seed))
+        upd2 = updater_for(rt, imaps2, eidx2, min_records=4)
+        upd2.config.max_segments_per_cycle = 2  # 2 segments per cycle
+        r1 = upd2.run_once()
+        assert r1 is not None and r1.published and r1.consumed_through == 2
+        runs[tag] = (rt, imaps2, eidx2, r1.generation)
+
+    rt_a, imaps_a, eidx_a, _ = runs["a"]
+    upd_a = updater_for(rt_a, imaps_a, eidx_a, min_records=4)
+    r2a = upd_a.run_once()  # uninterrupted cycle 2
+    assert r2a is not None and r2a.published and r2a.consumed_through == 4
+
+    rt_b, imaps_b, eidx_b, gen2_b = runs["b"]
+    child = f"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from photon_tpu.data.index_map import EntityIndex, IndexMap
+from photon_tpu.estimators.config import (
+    FixedEffectCoordinateConfig, RandomEffectCoordinateConfig)
+from photon_tpu.stream.updater import StreamingUpdater, StreamingUpdaterConfig
+from photon_tpu.types import TaskType
+root = {rt_b!r}
+imaps = {{s: IndexMap.load(os.path.join(root, "index-map-" + s + ".json"))
+          for s in ("global", "per_user")}}
+eidx = EntityIndex.load(os.path.join(root, "entity-index-userId.json"))
+cfg = StreamingUpdaterConfig(
+    publish_root=root, spool_dir=os.path.join(root, "spool"),
+    task=TaskType.LOGISTIC_REGRESSION,
+    coordinate_configs=[FixedEffectCoordinateConfig("global", "global"),
+                        RandomEffectCoordinateConfig(
+                            "per_user", "userId", "per_user")],
+    update_sequence=["global", "per_user"], min_records=4,
+    locked_coordinates=["global"], num_iterations=1, norm_drift_bound=1e4)
+StreamingUpdater(cfg, imaps, {{"userId": eidx}}).run_once()
+raise SystemExit("expected SIGKILL before run_once returned")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo_dir + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    # Cycle-2 stream.consume call indices in the fresh child process:
+    # segment-3 → 0, segment-4 → 1, "train" → 2. Kill right before the
+    # solve, after every segment was consumed.
+    env["PHOTON_TPU_FAULT_PLAN"] = json.dumps(
+        {"rules": [{"site": "stream.consume", "kind": "kill", "at": [2]}]})
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == -9, (
+        f"child should die by SIGKILL, got rc={proc.returncode}\n"
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+    with open(os.path.join(rt_b, "LATEST")) as f:
+        assert f.read().strip() == gen2_b, "killed cycle must not move LATEST"
+
+    upd_b = updater_for(rt_b, imaps_b, eidx_b, min_records=4)  # "restart"
+    r2b = upd_b.run_once()
+    assert r2b is not None and r2b.published and r2b.consumed_through == 4
+    assert r2b.generation == r2a.generation
+    a3 = re_coefs(os.path.join(rt_a, r2a.generation), imaps_a, eidx_a)
+    b3 = re_coefs(os.path.join(rt_b, r2b.generation), imaps_b, eidx_b)
+    assert np.array_equal(a3, b3), "crash-resume model differs bitwise"
+
+    return {
+        "metric": "streaming_soak",
+        "unit": "promotions",
+        "value": len(promoted) - 1,
+        "wall_s": round(wall, 3),
+        "ok": ok,
+        "caller_errors": errors,
+        "retraces": retraces,
+        "promoted": promoted,
+        "staleness_p95_s": round(float(p95), 3),
+        "staleness_p50_s": round(float(stale["p50"]), 3),
+        "delta_publishes": len(deltas),
+        "full_model_bytes": full_bytes,
+        "max_delta_bytes": max(d["bytes"] for d in deltas),
+        "max_changed_entities": max(d["changed_entities"] for d in deltas),
+        "shadow_samples_verified": len(samples),
+        "crash_resume": "bit_identical",
+    }
+
+
 def run_serve_soak(
     duration_s: float = 20.0,
     workers: int = 2,
@@ -2832,6 +3248,14 @@ def main():
         # publish → shadow → promote → refuse a corrupt generation →
         # breaker-trip auto-rollback; zero caller errors, zero retraces.
         print(json.dumps(run_rollout_soak()))
+        return
+    if "--streaming-soak" in sys.argv:
+        # Streaming freshness loop end to end: feedback spool → continuous
+        # delta micro-generations → shadow → promote under live load; zero
+        # caller errors/retraces, staleness p95 < 60 s, ≤1% entities and
+        # <5% bytes per delta, shadow bit-parity, SIGKILL crash-resume
+        # bit-equivalence; CPU-measurable.
+        print(json.dumps(run_streaming_soak()))
         return
     if "--serve-soak" in sys.argv:
         # Multi-process front end under sustained mixed-tenant load with

@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|rollout|exhaustion|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|rollout|streaming|exhaustion|install|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -507,6 +507,20 @@ run_rollout() {
     echo "   rollout-soak smoke OK"
 }
 
+run_streaming() {
+    # Streaming-freshness smoke: the full feedback -> micro-generation
+    # loop live — serving lands scored requests + labels in the spool,
+    # the continuous updater turns sealed segments into per-entity DELTA
+    # micro-generations, and the rollout watcher shadows + promotes each
+    # one under uninterrupted load. run_streaming_soak asserts the
+    # ISSUE 11 bar itself: >=3 promotions, zero caller errors, zero
+    # retraces, staleness p95 < 60 s, <=1% entities and <5% bytes per
+    # delta, shadow bit-parity, and SIGKILL crash-resume bit-equivalence.
+    echo "== streaming: feedback spool -> delta micro-generations -> promote =="
+    JAX_PLATFORMS=cpu python bench.py --streaming-soak
+    echo "   streaming-soak smoke OK"
+}
+
 run_exhaustion() {
     # Resource-exhaustion smoke: device OOM, disk-full, and host memory
     # pressure injected through training, spill, checkpoint, telemetry,
@@ -535,7 +549,7 @@ run_install() {
     for cmd in photon-tpu-game-training photon-tpu-game-scoring \
                photon-tpu-train-glm photon-tpu-feature-indexing \
                photon-tpu-name-and-term-bags photon-tpu-game-serving \
-               photon-tpu-game-incremental; do
+               photon-tpu-game-incremental photon-tpu-game-streaming; do
         PYTHONPATH="$parent_site" "$tmp/venv/bin/$cmd" --help > /dev/null
         echo "   $cmd --help OK"
     done
@@ -554,9 +568,10 @@ case "$stage" in
     faults) run_faults ;;
     soak) run_soak ;;
     rollout) run_rollout ;;
+    streaming) run_streaming ;;
     exhaustion) run_exhaustion ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_rollout; run_exhaustion; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_rollout; run_streaming; run_exhaustion; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

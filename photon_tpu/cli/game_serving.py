@@ -169,6 +169,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-model-versions", type=int, default=2,
                    help="resident model generations (primary + candidates "
                         "pinnable via X-Model-Version)")
+    p.add_argument("--feedback-spool", default=None,
+                   help="directory for the streaming feedback spool: scored "
+                        "requests joined with labels reported via "
+                        "POST /v1/feedback land here as sealed JSONL "
+                        "segments for photon-tpu-game-streaming to consume "
+                        "(unset = feedback disabled)")
+    p.add_argument("--feedback-sample-fraction", type=float, default=1.0,
+                   help="fraction of scored requests retained for the label "
+                        "join (deterministic fractional sampling)")
+    p.add_argument("--feedback-tenant-fractions", default=None,
+                   help="per-tenant sampling overrides, e.g. 'abuser=0.01,"
+                        "partner=1.0'")
+    p.add_argument("--feedback-segment-records", type=int, default=256,
+                   help="seal a spool segment after this many records")
+    p.add_argument("--feedback-segment-age", type=float, default=5.0,
+                   help="seal a non-empty spool segment after this many "
+                        "seconds (bounds label->consumable latency)")
+    p.add_argument("--feedback-join-ttl", type=float, default=300.0,
+                   help="seconds a scored request waits for its label before "
+                        "the pending join is dropped")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -229,27 +249,87 @@ def _poison(publish_root: str, version: str, reason: str) -> None:
     registry().counter("serve_generations_poisoned_total").inc()
 
 
+def _observe_staleness(target: str) -> None:
+    """Label-arrival → serving-promoted lag for a streaming generation:
+    the promoted manifest records the oldest label it trained on; the gap
+    to now IS the freshness the whole loop exists to bound."""
+    from photon_tpu.io.model_io import load_generation_manifest
+    from photon_tpu.obs.metrics import registry
+
+    try:
+        manifest = load_generation_manifest(target) or {}
+    except (OSError, ValueError):
+        return
+    ts = (manifest.get("stream") or {}).get("oldestLabelTs")
+    if ts is None:
+        return
+    import time
+
+    lag = max(0.0, time.time() - float(ts))
+    registry().gauge("model_staleness_s").set(lag)
+    registry().histogram("model_staleness_s_hist").observe(lag)
+
+
+def _try_delta_install(engine, target: str) -> bool:
+    """In-place delta apply: when the detected generation is a delta layer
+    and its base is already resident, register it via the store-overlay
+    path — no disk load of the full model, no store rebuild, no warm-up.
+    False means 'not applicable here' (full layer, base not resident, or
+    entity growth) and the caller does the full resolved load."""
+    from photon_tpu.io.model_io import delta_info, read_delta_rows
+
+    info = delta_info(target)
+    if not info or not info.get("base"):
+        return False
+    try:
+        payload = read_delta_rows(
+            target, engine._index_maps, engine._entity_indexes
+        )
+        engine.load_delta_version(payload["base"], payload, target)
+        return True
+    except Exception as exc:  # noqa: BLE001 — fall back to the full load
+        logger.info(
+            "in-place delta apply of %s not possible (%s); falling back to "
+            "a full resolved load", target, exc,
+        )
+        return False
+
+
 def _install_generation(engine, target: str, opts: RolloutOptions,
                         stop: threading.Event, publish_root: str) -> str:
     """Load one detected generation with retry+backoff. Returns 'shadow'
     (resident, mirroring traffic), 'promoted' (direct reload), 'poisoned'
-    (attempts exhausted — never tried again), or 'stopped'."""
-    from photon_tpu.io.model_io import load_game_model
+    (attempts exhausted — never tried again), or 'stopped'.
+
+    A delta micro-generation whose base is resident applies IN PLACE
+    (per-entity row overlay onto the base's store — sub-second, no
+    warm-up); anything else takes the full load of the RESOLVED model, so
+    a delta chain loads correctly even on a cold start."""
+    from photon_tpu.io.model_io import load_resolved_game_model
     from photon_tpu.obs.metrics import registry
 
     delay = opts.backoff_s
     attempts = max(int(opts.max_reload_attempts), 1)
+    shadowing = opts.shadow_fraction > 0 and opts.shadow_quota > 0
     for attempt in range(1, attempts + 1):
         try:
-            model = load_game_model(
+            if _try_delta_install(engine, target):
+                if shadowing:
+                    engine.start_shadow(target, opts.shadow_fraction)
+                    return "shadow"
+                engine.promote(target)
+                _observe_staleness(target)
+                return "promoted"
+            model = load_resolved_game_model(
                 target, engine._index_maps, engine._entity_indexes,
-                to_device=False,
+                to_device=False, publish_root=publish_root,
             )
-            if opts.shadow_fraction > 0 and opts.shadow_quota > 0:
+            if shadowing:
                 engine.load_version(model, model_version=target)
                 engine.start_shadow(target, opts.shadow_fraction)
                 return "shadow"
             engine.reload(model, model_version=target)
+            _observe_staleness(target)
             return "promoted"
         except Exception as exc:  # noqa: BLE001 — old model keeps serving
             logger.warning(
@@ -330,6 +410,7 @@ def _reload_watcher(engine, model_dir: str, interval: float,
                     candidate, st["count"], st["max_divergence"],
                 )
                 engine.promote(candidate)
+                _observe_staleness(candidate)
                 candidate = None
         # Post-promotion health: breaker-trip delta since the promotion.
         if opts.breaker_trip_bound > 0:
@@ -438,6 +519,30 @@ def _start_background(args, engine, stop: threading.Event) -> None:
         ).start()
 
 
+def _attach_feedback(args, engine) -> None:
+    """Wire the streaming feedback spool (engine owns its lifecycle)."""
+    if not getattr(args, "feedback_spool", None):
+        return
+    from photon_tpu.stream.spool import FeedbackSpool, SpoolConfig
+
+    fractions = {}
+    if args.feedback_tenant_fractions:
+        for part in args.feedback_tenant_fractions.split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                fractions[k.strip()] = float(v)
+    spool = FeedbackSpool(args.feedback_spool, SpoolConfig(
+        segment_max_records=args.feedback_segment_records,
+        segment_max_age_s=args.feedback_segment_age,
+        sample_fraction=args.feedback_sample_fraction,
+        tenant_fractions=fractions,
+        join_ttl_s=args.feedback_join_ttl,
+    ))
+    spool.start_auto_flush()
+    engine.attach_feedback(spool)
+    logger.info("feedback spool attached at %s", args.feedback_spool)
+
+
 def _load_engine(args, config: ServeConfig):
     model_dir = resolve_model_dir(args.model_input_dir)
     logger.info("loading + warming model from %s", model_dir)
@@ -446,7 +551,9 @@ def _load_engine(args, config: ServeConfig):
         # LATEST resolved to a generation subdir; the artifacts live
         # beside the generations, in the publication root.
         artifacts = args.model_input_dir
-    return load_engine(model_dir, artifacts_dir=artifacts, config=config)
+    engine = load_engine(model_dir, artifacts_dir=artifacts, config=config)
+    _attach_feedback(args, engine)
+    return engine
 
 
 def _startup_banner(engine, host, port, workers: int) -> None:

@@ -1,0 +1,182 @@
+"""Streaming GAME updater driver: continuous gated micro-generations.
+
+TPU-new driver closing the freshness loop at traffic speed. Where
+``game_incremental`` runs ONE guarded generation per invocation from delta
+files, this driver runs as a long-lived process against a *publish root*
+and the serving side's feedback spool (``game_serving --feedback-spool``):
+
+1. polls the spool for sealed segments of joined (request, label) records,
+2. warm-starts from ``LATEST`` and re-trains only the entities those
+   records touched (the same incremental machinery — row-level merge,
+   active-set solves),
+3. publishes each result as a per-entity DELTA layer (base + changed rows;
+   ``--no-delta`` forces full generations) through the same validation gate
+   and fsync'd ``LATEST`` pointer,
+4. repeats on ``--cadence`` until stopped (or ``--max-cycles`` publishes,
+   for bounded runs and tests).
+
+The consume cursor lives in the generation manifests themselves
+(``stream.consumedThrough``), so a killed and restarted updater never
+double-applies a segment — see ``photon_tpu/stream/updater.py``.
+
+Usage:
+
+  python -m photon_tpu.cli.game_streaming \\
+    --publish-root out/ --spool-dir out/feedback/ \\
+    --coordinate-configurations name=global,feature.shard=globalShard \\
+      name=perUser,feature.shard=globalShard,random.effect.type=userId \\
+    --update-sequence global,perUser --cadence 5 --lock-coordinates global
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+from typing import Dict
+
+from photon_tpu.cli.common import (
+    parse_coordinate_config,
+    setup_logging,
+    task_of,
+)
+from photon_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("game-streaming")
+    p.add_argument("--publish-root", required=True,
+                   help="a game_training output dir: generations + LATEST "
+                        "pointer + index-map-*.json / entity-index-*.json; "
+                        "micro-generations are written as subdirs here")
+    p.add_argument("--spool-dir", required=True,
+                   help="the feedback spool directory game_serving writes "
+                        "(sealed segment-*.jsonl files are consumed)")
+    p.add_argument("--coordinate-configurations", nargs="+", required=True)
+    p.add_argument("--update-sequence", required=True,
+                   help="comma-separated coordinate ids")
+    p.add_argument("--task", default="LOGISTIC_REGRESSION",
+                   choices=[t.name for t in TaskType])
+    p.add_argument("--cadence", type=float, default=5.0,
+                   help="seconds between spool polls")
+    p.add_argument("--min-records", type=int, default=8,
+                   help="skip the solve until at least this many joined "
+                        "records are pending (segments accumulate)")
+    p.add_argument("--max-segments", type=int, default=64,
+                   help="cap on segments folded into one micro-generation")
+    p.add_argument("--max-cycles", type=int, default=None,
+                   help="stop after this many publishes (default: run until "
+                        "signalled)")
+    p.add_argument("--lock-coordinates", default="",
+                   help="comma-separated coordinate ids to keep fixed "
+                        "(typically the fixed effects: micro-batches are "
+                        "too small to re-fit the global model)")
+    p.add_argument("--no-delta", action="store_true",
+                   help="publish full generations instead of delta layers")
+    p.add_argument("--full-every", type=int, default=0,
+                   help="force every k-th publish to be a full generation, "
+                        "bounding delta-chain length (0: never force)")
+    p.add_argument("--holdout-fraction", type=float, default=0.0,
+                   help="fraction of records held out (deterministically) "
+                        "for the gate's regression bound; 0 disables")
+    p.add_argument("--evaluators", nargs="*", default=["AUC"])
+    p.add_argument("--metric-tolerance", type=float, default=0.02)
+    p.add_argument("--norm-drift-bound", type=float, default=10.0)
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument("--re-convergence-tol", type=float, default=1e-4)
+    p.add_argument("--telemetry-out", default=None)
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def run(args) -> Dict:
+    setup_logging(args.verbose)
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.obs import begin_run, finalize_run_report
+    from photon_tpu.stream.updater import (
+        StreamingUpdater,
+        StreamingUpdaterConfig,
+    )
+
+    begin_run()
+    task = task_of(args)
+    coord_configs = [
+        parse_coordinate_config(s) for s in args.coordinate_configurations
+    ]
+    update_sequence = [
+        s.strip() for s in args.update_sequence.split(",") if s.strip()
+    ]
+    by_id = {c.coordinate_id: c for c in coord_configs}
+    coord_configs = [by_id[cid] for cid in update_sequence]
+
+    # The publish root's artifacts are authoritative — the updater joins a
+    # lineage the batch trainer started, it never invents a feature space.
+    index_maps = {}
+    for fn in os.listdir(args.publish_root):
+        if fn.startswith("index-map-") and fn.endswith(".json"):
+            shard = fn[len("index-map-"):-len(".json")]
+            index_maps[shard] = IndexMap.load(
+                os.path.join(args.publish_root, fn)
+            )
+    entity_indexes = {}
+    for fn in os.listdir(args.publish_root):
+        if fn.startswith("entity-index-") and fn.endswith(".json"):
+            re_type = fn[len("entity-index-"):-len(".json")]
+            entity_indexes[re_type] = EntityIndex.load(
+                os.path.join(args.publish_root, fn)
+            )
+    if not index_maps:
+        raise SystemExit(
+            f"no index-map-*.json under {args.publish_root!r}: the publish "
+            "root must come from a game_training run"
+        )
+
+    updater = StreamingUpdater(
+        StreamingUpdaterConfig(
+            publish_root=args.publish_root,
+            spool_dir=args.spool_dir,
+            task=task,
+            coordinate_configs=coord_configs,
+            update_sequence=update_sequence,
+            cadence_s=args.cadence,
+            min_records=args.min_records,
+            max_segments_per_cycle=args.max_segments,
+            locked_coordinates=[
+                s for s in args.lock_coordinates.split(",") if s
+            ],
+            delta_artifacts=not args.no_delta,
+            full_every=args.full_every,
+            holdout_fraction=args.holdout_fraction,
+            evaluators=list(args.evaluators),
+            metric_tolerance=args.metric_tolerance,
+            norm_drift_bound=args.norm_drift_bound,
+            num_iterations=args.coordinate_descent_iterations,
+            re_convergence_tol=args.re_convergence_tol,
+        ),
+        index_maps,
+        entity_indexes,
+    )
+    try:
+        cycles = updater.run_forever(max_cycles=args.max_cycles)
+    except KeyboardInterrupt:
+        updater.stop()
+        cycles = updater.stats()["cycles"]
+    finalize_run_report("game_streaming", path=args.telemetry_out)
+    stats = updater.stats()
+    return {
+        "cycles": cycles,
+        "publishes": stats["publishes"],
+        "consumedThrough": stats["consumed_through"],
+    }
+
+
+def main(argv=None):
+    summary = run(build_parser().parse_args(argv))
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -378,12 +378,21 @@ def write_generation_manifest(
     fine everywhere but the gate's checksum pass must refuse it."""
     from photon_tpu.utils import faults
 
+    checksums = generation_checksums(model_dir)
+    sizes = {
+        rel: os.path.getsize(os.path.join(model_dir, rel)) for rel in checksums
+    }
     manifest = {
         "generation": os.path.basename(model_dir.rstrip("/")),
         "parent": parent,
         "createdAt": time.time(),
         "holdoutMetrics": dict(holdout_metrics or {}),
-        "files": generation_checksums(model_dir),
+        "files": checksums,
+        # Byte accounting feeds the delta-vs-full publish assertion in the
+        # streaming soak: a delta layer's totalBytes must be a small fraction
+        # of its base generation's.
+        "fileBytes": sizes,
+        "totalBytes": int(sum(sizes.values())),
         "gate": {"status": "candidate", "reason": None},
         **(extra or {}),
     }
@@ -407,35 +416,133 @@ def load_generation_manifest(model_dir: str) -> Optional[dict]:
         return json.load(f)
 
 
-def coordinate_norms(model_dir: str) -> Dict[str, dict]:
+def delta_info(model_dir: str) -> Optional[dict]:
+    """The ``delta`` block of a generation's metadata ({"base": <generation>,
+    "changedEntities": {...}}), or None for a full self-contained generation.
+    Reads the raw metadata JSON — a delta layer always carries the metadata
+    this repo writes (there is no reference-layout fallback for deltas)."""
+    path = os.path.join(model_dir, METADATA_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f).get("delta")
+    except (OSError, ValueError):
+        return None
+
+
+def resolve_delta_chain(
+    model_dir: str,
+    publish_root: Optional[str] = None,
+    max_depth: int = 128,
+) -> list:
+    """Resolution chain for a generation, base-first: ``[full_base, delta_1,
+    ..., model_dir]``. A full generation resolves to ``[model_dir]``. Bases
+    are looked up as siblings under ``publish_root`` (default: the
+    generation's own parent directory). Raises FileNotFoundError when a
+    referenced base is missing, ValueError on a cycle or over-deep chain —
+    the gate turns either into a refusal, never a published generation."""
+    publish_root = publish_root or os.path.dirname(
+        os.path.abspath(model_dir.rstrip("/"))
+    )
+    chain: list = []
+    seen = set()
+    cur = model_dir
+    while True:
+        name = os.path.basename(cur.rstrip("/"))
+        if name in seen:
+            raise ValueError(f"delta chain cycle at {name!r}")
+        seen.add(name)
+        chain.append(cur)
+        if len(chain) > max_depth:
+            raise ValueError(
+                f"delta chain deeper than {max_depth} from {model_dir!r}"
+            )
+        info = delta_info(cur)
+        if not info:
+            chain.reverse()
+            return chain
+        base = info.get("base")
+        if not base:
+            raise ValueError(f"delta generation {name!r} names no base")
+        cand = base if os.path.isabs(base) else os.path.join(publish_root, base)
+        if not os.path.isdir(cand):
+            raise FileNotFoundError(
+                f"delta base {base!r} of {name!r} missing under "
+                f"{publish_root!r}"
+            )
+        cur = cand
+
+
+def _resolved_coordinate_records(
+    model_dir: str, publish_root: Optional[str] = None
+) -> Tuple[Dict[str, dict], Dict[str, dict]]:
+    """Resolve a generation's delta chain into per-coordinate record maps:
+    ``(coordinates, {cid: {modelId: record}})`` where later layers overwrite
+    earlier records row-by-row (an entity's record in a delta replaces the
+    base's record for that entity; everything else rides through verbatim)."""
+    chain = resolve_delta_chain(model_dir, publish_root)
+    coordinates: Dict[str, dict] = {}
+    records: Dict[str, dict] = {}
+    for layer in chain:
+        meta = read_model_metadata(layer)
+        for cid, info in meta["coordinates"].items():
+            coordinates.setdefault(cid, dict(info))
+            sub = FIXED_DIR if info.get("type") == "fixed" else RANDOM_DIR
+            cdir = os.path.join(layer, sub, cid)
+            per = records.setdefault(cid, {})
+            for path in _coefficient_files(cdir):
+                for rec in read_avro_records(path):
+                    per[rec["modelId"]] = rec
+    return coordinates, records
+
+
+def _norms_over_records(recs) -> dict:
+    import math
+
+    sq = 0.0
+    n = 0
+    finite = True
+    for rec in recs:
+        n += 1
+        for ntv in rec.get("means") or ():
+            v = float(ntv["value"])
+            if not math.isfinite(v):
+                finite = False
+            else:
+                sq += v * v
+        for ntv in rec.get("variances") or ():
+            if not math.isfinite(float(ntv["value"])):
+                finite = False
+    return {"l2": math.sqrt(sq), "records": n, "finite": finite}
+
+
+def coordinate_norms(model_dir: str, resolve_deltas: bool = True) -> Dict[str, dict]:
     """Per-coordinate coefficient summary straight off the Avro part files
     (no index maps needed): L2 norm over all recorded means, record count,
     and whether every value (means + variances) is finite. This is what the
     gate's coefficient-sanity pass runs on — it must not depend on loading
-    artifacts that could themselves be the corrupted thing."""
-    import math
+    artifacts that could themselves be the corrupted thing.
 
+    A delta generation is summarized over its RESOLVED chain (base rows
+    overwritten by each layer in order): a micro-generation that touched 10
+    of a million entities should show near-zero norm drift vs its parent,
+    not the norm of 10 rows vs a million."""
+    if resolve_deltas and delta_info(model_dir) is not None:
+        _coords, records = _resolved_coordinate_records(model_dir)
+        return {cid: _norms_over_records(per.values())
+                for cid, per in records.items()}
     out: Dict[str, dict] = {}
     meta = read_model_metadata(model_dir)
     for cid, info in meta.get("coordinates", {}).items():
         sub = FIXED_DIR if info.get("type") == "fixed" else RANDOM_DIR
         cdir = os.path.join(model_dir, sub, cid)
-        sq = 0.0
-        n = 0
-        finite = True
-        for path in _coefficient_files(cdir):
-            for rec in read_avro_records(path):
-                n += 1
-                for ntv in rec.get("means") or ():
-                    v = float(ntv["value"])
-                    if not math.isfinite(v):
-                        finite = False
-                    else:
-                        sq += v * v
-                for ntv in rec.get("variances") or ():
-                    if not math.isfinite(float(ntv["value"])):
-                        finite = False
-        out[cid] = {"l2": math.sqrt(sq), "records": n, "finite": finite}
+
+        def _iter(cdir=cdir):
+            for path in _coefficient_files(cdir):
+                yield from read_avro_records(path)
+
+        out[cid] = _norms_over_records(_iter())
     return out
 
 
@@ -507,6 +614,27 @@ def verify_generation(
         if actual != digest:
             return GateResult(False, f"checksum_mismatch: {rel}", checks)
     checks["files_verified"] = len(recorded)
+
+    # 1b. delta chain — a delta layer is only as good as the bases it
+    # resolves through: a missing/cyclic chain or a poisoned base refuses
+    # the candidate outright (the resolved model would embed bad rows).
+    if delta_info(model_dir) is not None:
+        publish_root = os.path.dirname(os.path.abspath(model_dir.rstrip("/")))
+        try:
+            chain = resolve_delta_chain(model_dir, publish_root)
+        except (OSError, ValueError) as exc:
+            return GateResult(False, f"delta_chain_unresolvable: {exc}", checks)
+        checks["delta_chain"] = [
+            os.path.basename(p.rstrip("/")) for p in chain
+        ]
+        for layer in chain[:-1]:
+            if is_poisoned(publish_root, layer):
+                return GateResult(
+                    False,
+                    "delta_base_poisoned: "
+                    f"{os.path.basename(layer.rstrip('/'))}",
+                    checks,
+                )
 
     # 2. coefficient sanity (+ norm drift vs parent)
     try:
@@ -668,6 +796,382 @@ def next_generation_name(publish_root: str, prefix: str = "gen-") -> str:
                 except ValueError:
                     continue
     return f"{prefix}{best + 1}"
+
+
+def allocate_generation(publish_root: str, prefix: str = "gen-") -> str:
+    """Claim the next unused generation name under the publish root.
+
+    ``next_generation_name`` alone is a racy listdir scan: two concurrent
+    updaters (batch incremental + streaming, or two streaming workers) can
+    both see ``gen-4`` free and clobber each other's artifacts. Allocation
+    runs under an exclusive flock on a sidecar lock file — same discipline
+    ``mark_poisoned`` uses for the poison list — and the directory is
+    created INSIDE the lock, so the claim is visible to the next scanner
+    the moment the lock drops. A claimant that crashes before publishing
+    leaves an inert unpublished directory behind; the next allocation simply
+    skips past it."""
+    os.makedirs(publish_root, exist_ok=True)
+    with open(os.path.join(publish_root, ".generation-allocate.lock"), "a") as lockf:
+        try:
+            import fcntl
+
+            fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
+        except ImportError:  # non-POSIX: best-effort, single-writer only
+            pass
+        name = next_generation_name(publish_root, prefix)
+        os.makedirs(os.path.join(publish_root, name))
+    return name
+
+
+def save_delta_model(
+    model: GameModel,
+    changed_entities: Dict[str, np.ndarray],
+    output_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Dict[str, EntityIndex],
+    base: str,
+    sparsity_threshold: float = 0.0,
+    include_fixed: bool = False,
+    extra_metadata: Optional[dict] = None,
+) -> Dict[str, int]:
+    """Write a per-entity DELTA generation: only the rows named by
+    ``changed_entities`` (``{re_type: bool mask or int index array}``) are
+    persisted, in the exact same per-coordinate Avro layout as a full
+    generation, plus metadata carrying ``{"delta": {"base": <generation>}}``.
+    Resolving the layer over its base (``load_resolved_game_model``) must be
+    bit-identical to publishing the whole model, which is why the default
+    sparsity threshold here is 0.0 — a micro-generation exists to move
+    freshness, not to shrink records it doesn't own.
+
+    Fixed effects are omitted unless ``include_fixed`` — the streaming
+    updater locks them, so the base's FE rides through the resolve verbatim.
+    Returns per-coordinate written record counts."""
+    os.makedirs(output_dir, exist_ok=True)
+    base = os.path.basename(base.rstrip("/"))
+    written: Dict[str, int] = {}
+    meta: dict = {"coordinates": {}, **(extra_metadata or {})}
+    changed_counts: Dict[str, int] = {}
+
+    for cid, sub in model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            if not include_fixed:
+                continue
+            cdir = os.path.join(output_dir, FIXED_DIR, cid, COEFF_DIR)
+            os.makedirs(cdir, exist_ok=True)
+            with open(
+                os.path.join(output_dir, FIXED_DIR, cid, ID_INFO_FILE), "w"
+            ) as f:
+                f.write(sub.feature_shard + "\n")
+            rec = _coeffs_to_avro(
+                cid,
+                np.asarray(sub.model.coefficients.means),
+                None
+                if sub.model.coefficients.variances is None
+                else np.asarray(sub.model.coefficients.variances),
+                index_maps[sub.feature_shard],
+                sub.model.task,
+                sparsity_threshold,
+            )
+            write_avro_records(
+                os.path.join(cdir, "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL_SCHEMA,
+                [rec],
+            )
+            meta["coordinates"][cid] = {
+                "type": "fixed",
+                "featureShard": sub.feature_shard,
+                "task": sub.model.task.value,
+                "dim": int(sub.model.coefficients.dim),
+            }
+            written[cid] = 1
+        elif isinstance(sub, RandomEffectModel):
+            mask = changed_entities.get(sub.re_type)
+            if mask is None:
+                continue
+            coefs = np.asarray(sub.coefficients)
+            mask = np.asarray(mask)
+            if mask.dtype == bool:
+                idx = np.flatnonzero(mask)
+            else:
+                idx = np.unique(mask.astype(np.int64))
+            idx = idx[idx < coefs.shape[0]]
+            if idx.size == 0:
+                continue
+            cdir = os.path.join(output_dir, RANDOM_DIR, cid)
+            os.makedirs(os.path.join(cdir, COEFF_DIR), exist_ok=True)
+            with open(os.path.join(cdir, ID_INFO_FILE), "w") as f:
+                f.write(sub.re_type + "\n" + sub.feature_shard + "\n")
+            imap = index_maps[sub.feature_shard]
+            eidx = entity_indexes.get(sub.re_type)
+            variances = None if sub.variances is None else np.asarray(sub.variances)
+            records = []
+            for e in idx:
+                e = int(e)
+                model_id = eidx.entity_id(e) if eidx is not None else str(e)
+                records.append(
+                    _coeffs_to_avro(
+                        model_id,
+                        coefs[e],
+                        None if variances is None else variances[e],
+                        imap,
+                        sub.task,
+                        sparsity_threshold,
+                    )
+                )
+            write_avro_records(
+                os.path.join(cdir, COEFF_DIR, "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL_SCHEMA,
+                records,
+            )
+            meta["coordinates"][cid] = {
+                "type": "random",
+                "reType": sub.re_type,
+                "featureShard": sub.feature_shard,
+                "task": sub.task.value,
+                "dim": int(coefs.shape[1]),
+                "numEntities": int(idx.size),
+            }
+            written[cid] = int(idx.size)
+            changed_counts[sub.re_type] = changed_counts.get(
+                sub.re_type, 0
+            ) + int(idx.size)
+        elif isinstance(sub, ProjectedRandomEffectModel):
+            raise ValueError(
+                f"coordinate {cid!r}: projected random effects do not "
+                "support delta layers — publish a full generation"
+            )
+    if not written:
+        raise ValueError(
+            "delta generation would be empty: no changed entities named "
+            "and fixed effects excluded"
+        )
+    tasks = [c["task"] for c in meta["coordinates"].values()]
+    if tasks:
+        meta.setdefault("modelType", tasks[0])
+    meta["delta"] = {"base": base, "changedEntities": changed_counts}
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+    return written
+
+
+def read_delta_rows(
+    model_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Dict[str, EntityIndex],
+) -> dict:
+    """Decode one delta layer into the serving fast-apply payload:
+    ``{"base": <generation>, "re_rows": {cid: (entity_idx int64[m],
+    rows float32[m, d])}, "fixed": {cid: means float32[d]}}``. Entity ids
+    must already exist in ``entity_indexes`` (the publisher persists grown
+    indexes before the manifest); an unknown id raises ValueError and the
+    caller falls back to a full resolved load."""
+    info = delta_info(model_dir)
+    if info is None:
+        raise ValueError(f"{model_dir!r} is not a delta generation")
+    meta = read_model_metadata(model_dir)
+    out: dict = {"base": info.get("base"), "re_rows": {}, "fixed": {}}
+    for cid, cinfo in meta["coordinates"].items():
+        imap = index_maps[cinfo["featureShard"]]
+        dim = cinfo.get("dim", len(imap))
+        if cinfo["type"] == "fixed":
+            cdir = os.path.join(model_dir, FIXED_DIR, cid)
+            recs = []
+            for path in _coefficient_files(cdir):
+                recs.extend(read_avro_records(path))
+            if len(recs) != 1:
+                raise ValueError(
+                    f"delta fixed-effect {cid!r}: expected one record, "
+                    f"got {len(recs)}"
+                )
+            means, _variances, _task = _avro_to_coeffs(recs[0], imap, dim)
+            out["fixed"][cid] = means
+        else:
+            cdir = os.path.join(model_dir, RANDOM_DIR, cid)
+            with open(os.path.join(cdir, ID_INFO_FILE)) as f:
+                re_type = f.read().split()[0]
+            eidx = entity_indexes.get(re_type)
+            if eidx is None:
+                raise ValueError(
+                    f"delta coordinate {cid!r}: no entity index for "
+                    f"{re_type!r}"
+                )
+            idx, rows = [], []
+            for path in _coefficient_files(cdir):
+                for rec in read_avro_records(path):
+                    e = eidx.lookup(rec["modelId"])
+                    if e < 0:
+                        raise ValueError(
+                            f"delta coordinate {cid!r}: entity "
+                            f"{rec['modelId']!r} unknown to the serving "
+                            "entity index"
+                        )
+                    means, _variances, _task = _avro_to_coeffs(rec, imap, dim)
+                    idx.append(e)
+                    rows.append(means)
+            if idx:
+                out["re_rows"][cid] = (
+                    np.asarray(idx, np.int64),
+                    np.stack(rows).astype(np.float32),
+                )
+    return out
+
+
+def load_resolved_game_model(
+    model_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    to_device: bool = True,
+    publish_root: Optional[str] = None,
+) -> GameModel:
+    """Load a generation with its delta chain applied: the full base loads
+    host-side, then each layer's records overwrite the matching entity rows
+    (interning may grow the entity space — a streaming layer can introduce
+    entities the base never saw). The result is bit-identical to loading an
+    equivalent whole-model publish. A full generation degrades to plain
+    ``load_game_model``."""
+    chain = resolve_delta_chain(model_dir, publish_root)
+    entity_indexes = entity_indexes if entity_indexes is not None else {}
+    model = load_game_model(
+        chain[0], index_maps, entity_indexes, to_device=False
+    )
+    for layer in chain[1:]:
+        model = _apply_delta_layer(model, layer, index_maps, entity_indexes)
+    if not to_device:
+        return model
+    return GameModel({
+        cid: _submodel_to_device(sub) for cid, sub in model.models.items()
+    })
+
+
+def _submodel_to_device(sub):
+    if isinstance(sub, FixedEffectModel):
+        c = sub.model.coefficients
+        return FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(
+                    jnp.asarray(c.means),
+                    None if c.variances is None else jnp.asarray(c.variances),
+                ),
+                sub.model.task,
+            ),
+            sub.feature_shard,
+        )
+    if isinstance(sub, RandomEffectModel):
+        return RandomEffectModel(
+            jnp.asarray(sub.coefficients),
+            sub.re_type,
+            sub.feature_shard,
+            sub.task,
+            None if sub.variances is None else jnp.asarray(sub.variances),
+            present_entities=None
+            if sub.present_entities is None
+            else jnp.asarray(sub.present_entities),
+        )
+    return sub
+
+
+def _apply_delta_layer(
+    model: GameModel,
+    layer_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Dict[str, EntityIndex],
+) -> GameModel:
+    """Overwrite ``model``'s rows with one delta layer's records, growing
+    per-type entity spaces when the layer introduces new ids. Host-side
+    numpy only — callers device-put once, after the last layer."""
+    meta = read_model_metadata(layer_dir)
+    models = dict(model.models)
+    for cid, info in meta["coordinates"].items():
+        imap = index_maps[info["featureShard"]]
+        dim = info.get("dim", len(imap))
+        if info["type"] == "fixed":
+            cdir = os.path.join(layer_dir, FIXED_DIR, cid)
+            recs = []
+            for path in _coefficient_files(cdir):
+                recs.extend(read_avro_records(path))
+            if len(recs) != 1:
+                raise ValueError(
+                    f"delta fixed-effect {cid!r}: expected one record, "
+                    f"got {len(recs)}"
+                )
+            means, variances, _task = _avro_to_coeffs(recs[0], imap, dim)
+            old = models.get(cid)
+            if not isinstance(old, FixedEffectModel):
+                raise ValueError(
+                    f"delta fixed-effect {cid!r} has no fixed base coordinate"
+                )
+            oldc = old.model.coefficients
+            models[cid] = FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(
+                        means,
+                        variances
+                        if variances is not None
+                        else (
+                            None
+                            if oldc.variances is None
+                            else np.asarray(oldc.variances)
+                        ),
+                    ),
+                    old.model.task,
+                ),
+                old.feature_shard,
+            )
+        else:
+            cdir = os.path.join(layer_dir, RANDOM_DIR, cid)
+            with open(os.path.join(cdir, ID_INFO_FILE)) as f:
+                re_type = f.read().split()[0]
+            old = models.get(cid)
+            if not isinstance(old, RandomEffectModel):
+                raise ValueError(
+                    f"delta coordinate {cid!r} has no random-effect base "
+                    "coordinate"
+                )
+            eidx = entity_indexes.setdefault(re_type, EntityIndex())
+            recs = []
+            for path in _coefficient_files(cdir):
+                recs.extend(read_avro_records(path))
+            for rec in recs:
+                eidx.intern(rec["modelId"])
+            E = len(eidx)
+            coefs = np.asarray(old.coefficients)
+            present = (
+                np.zeros((coefs.shape[0],), bool)
+                if old.present_entities is None
+                else np.asarray(old.present_entities).copy()
+            )
+            variances_arr = (
+                None if old.variances is None else np.asarray(old.variances)
+            )
+            if E > coefs.shape[0]:  # layer introduced new entities
+                grow = E - coefs.shape[0]
+                coefs = np.vstack(
+                    [coefs, np.zeros((grow, coefs.shape[1]), np.float32)]
+                )
+                present = np.concatenate([present, np.zeros((grow,), bool)])
+                if variances_arr is not None:
+                    variances_arr = np.vstack([
+                        variances_arr,
+                        np.zeros((grow, variances_arr.shape[1]), np.float32),
+                    ])
+            else:
+                coefs = coefs.copy()
+            for rec in recs:
+                e = eidx.lookup(rec["modelId"])
+                means, variances, _task = _avro_to_coeffs(rec, imap, dim)
+                coefs[e] = means
+                present[e] = True
+                if variances is not None and variances_arr is not None:
+                    variances_arr[e] = variances
+            models[cid] = RandomEffectModel(
+                coefs,
+                re_type,
+                old.feature_shard,
+                old.task,
+                variances_arr,
+                present_entities=present,
+            )
+    return GameModel(models)
 
 
 def _scan_model_dir(model_dir: str, meta: dict) -> Dict[str, dict]:

@@ -64,6 +64,9 @@ class ScoreRequest:
     # primary for unpinned requests, or on a pin-evicted fallback), so
     # response labels are always truthful.
     model_version: Optional[str] = None
+    # Set by ServingEngine.submit from its ``tenant`` argument: rides along
+    # so the feedback spool can apply per-tenant sampling fractions.
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass

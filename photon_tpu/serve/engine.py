@@ -193,6 +193,9 @@ class ServingEngine:
         self._shadow_div_sum = 0.0
         self._shadow_div_max = 0.0
         self._promotion: Optional[Dict] = None
+        # Feedback spool (streaming freshness loop): when attached, every
+        # scored primary request is offered to the spool's label join.
+        self._feedback = None
         self.batcher = MicroBatcher(
             self._score_batch,
             max_batch_size=self.max_batch,
@@ -447,9 +450,38 @@ class ServingEngine:
                 sub = [requests[i] for i in idxs]
                 scores = self._score_on(self._states[key], sub)
                 out[idxs] = scores
-                if key == self._primary and self._shadow in self._states:
-                    self._maybe_shadow_score(sub, scores)
+                if key == self._primary:
+                    if self._shadow in self._states:
+                        self._maybe_shadow_score(sub, scores)
+                    if self._feedback is not None:
+                        self._record_feedback(sub, scores)
             return out
+
+    def _record_feedback(
+        self, requests: List[ScoreRequest], scores: np.ndarray
+    ) -> None:
+        """Land scored primary requests in the feedback spool's label join.
+        Observability-only: a spool failure counts, never surfaces to the
+        caller or the scoring path."""
+        spool = self._feedback
+        if spool is None:
+            return
+        try:
+            for r, s in zip(requests, scores):
+                if r.uid is None:
+                    continue  # no join key: the label could never match
+                spool.observe_scored(
+                    uid=r.uid,
+                    features=r.features,
+                    entity_ids=r.entity_ids,
+                    offset=r.offset,
+                    score=float(s),
+                    model_version=r.model_version,
+                    tenant=getattr(r, "tenant", None),
+                )
+        except Exception as exc:  # noqa: BLE001 — feedback never hurts callers
+            registry().counter("feedback_errors_total").inc()
+            logger.warning("serving: feedback spool observe failed: %s", exc)
 
     def _maybe_shadow_score(
         self, requests: List[ScoreRequest], primary_scores: np.ndarray
@@ -518,6 +550,8 @@ class ServingEngine:
         if pin is not None:
             with self._lock:
                 request.model_version = self._resolve_version(pin)
+        if tenant is not None:
+            request.tenant = tenant  # per-tenant feedback sampling
         if deadline_s is None and self.config.default_deadline_ms is not None:
             deadline_s = self.config.default_deadline_ms / 1000.0
         self.admission.admit(
@@ -666,6 +700,86 @@ class ServingEngine:
         registry().counter("serve_model_reloads_total").inc()
         return dict(model_version=version, store=new_state.store.stats())
 
+    def load_delta_version(
+        self, base_version: str, delta: Dict, model_version: str
+    ) -> Dict:
+        """Register a micro-generation as a RESIDENT version by applying a
+        per-entity delta onto an already-resident base — no disk load of the
+        full model, no store rebuild, no warm-up pass.
+
+        ``delta`` is the ``io.model_io.read_delta_rows`` payload:
+        ``{"re_rows": {cid: (entity_idx, rows)}, "fixed": {cid: means}}``.
+        The clone's scoring pytree has the same structure as the base's, so
+        the BASE's warmed transformer serves it — a delta load is O(changed
+        rows) in device work and compiles nothing (the scatter shapes hit
+        the module-global jit cache). Raises :class:`ReloadError` when the
+        base is not resident or the delta is not applicable in place (entity
+        growth, projected coordinate) — callers fall back to a full
+        ``load_version``."""
+        self._reloads += 1
+        version = model_version
+        try:
+            faults.check("serve.reload")
+            with self._lock:
+                base_key = self._resolve_version(base_version)
+                base_state = self._states[base_key]
+            with tracer().span("serve/delta_apply"):
+                store = base_state.store.clone_with_delta(
+                    delta.get("re_rows") or {}, delta.get("fixed") or {}
+                )
+            # Shared transformer: identical pytree structure means zero new
+            # traces; warm_traces snapshots the shared counter so the
+            # retrace contract stays a strict zero-iff-no-retrace signal.
+            new_state = _State(
+                store, base_state.transformer, version,
+                base_state.transformer.trace_count,
+            )
+        except Exception as exc:  # noqa: BLE001 — keep serving what we have
+            self._reload_failures += 1
+            self._last_reload_error = f"{version}: {exc}"
+            registry().counter("serve_reload_failures_total").inc()
+            logger.warning(
+                "serving: delta load of %r onto %r failed (%s); resident "
+                "generations unchanged", version, base_version, exc,
+            )
+            raise ReloadError(
+                f"delta load to {version!r} failed: {exc}"
+            ) from exc
+        with self._lock:
+            self._states[version] = new_state
+            self._evict_locked(protect=version)
+            resident = version in self._states
+        if not resident:
+            self._reload_failures += 1
+            self._last_reload_error = f"{version}: evicted during load"
+            registry().counter("serve_reload_failures_total").inc()
+            raise ReloadError(
+                f"delta load to {version!r} failed: evicted during load"
+            )
+        self._last_reload_error = None
+        registry().counter("serve_delta_loads_total").inc()
+        return dict(
+            model_version=version, base=base_key, store=new_state.store.stats()
+        )
+
+    # -- feedback spool (streaming freshness loop) --------------------------
+
+    def attach_feedback(self, spool) -> None:
+        """Attach a :class:`~photon_tpu.stream.spool.FeedbackSpool`: scored
+        primary requests land in its label join; :meth:`feedback_label`
+        completes the join. The engine owns the spool's lifecycle from here
+        (closed with the engine)."""
+        self._feedback = spool
+
+    def feedback_label(
+        self, uid: str, label: float, ts: Optional[float] = None
+    ) -> bool:
+        """Report an observed label for a previously scored request. True
+        when the joined record landed in the spool."""
+        if self._feedback is None:
+            raise ValueError("feedback spool not enabled on this engine")
+        return self._feedback.observe_label(uid, label, ts)
+
     def start_shadow(
         self, model_version: str, fraction: Optional[float] = None
     ) -> None:
@@ -804,10 +918,18 @@ class ServingEngine:
             reload_failures=self._reload_failures,
             last_reload_error=self._last_reload_error,
             tenants=self.admission.snapshot(),
+            feedback=(
+                self._feedback.stats() if self._feedback is not None else None
+            ),
         )
 
     def close(self, drain: bool = True) -> None:
         self.batcher.close(drain=drain)
+        if self._feedback is not None:
+            try:
+                self._feedback.close()
+            except Exception:  # noqa: BLE001 — close must not raise
+                logger.exception("serving: feedback spool close failed")
 
 
 def load_engine(
@@ -821,13 +943,28 @@ def load_engine(
     dir (default: the model dir's parent = the training output dir), model
     loaded HOST-side (the store owns device residency)."""
     from photon_tpu.io.model_io import (
-        load_game_model,
+        delta_info,
+        load_resolved_game_model,
         model_re_types,
         read_model_metadata,
+        resolve_delta_chain,
     )
 
     artifacts = artifacts_dir or os.path.dirname(model_dir.rstrip("/"))
-    meta = read_model_metadata(model_dir)
+    # A cold start can land directly on a delta micro-generation (LATEST
+    # points at it): the coordinate/shard universe then comes from the
+    # whole resolved chain, not the layer's few touched coordinates.
+    layers = (
+        resolve_delta_chain(model_dir)
+        if delta_info(model_dir) is not None
+        else [model_dir]
+    )
+    meta: Dict[str, object] = {"coordinates": {}}
+    for layer in layers:
+        for cid, info in read_model_metadata(layer).get(
+            "coordinates", {}
+        ).items():
+            meta["coordinates"].setdefault(cid, info)
     index_maps: Dict[str, IndexMap] = {}
     for coord in meta.get("coordinates", {}).values():
         shard = coord.get("featureShard")
@@ -839,7 +976,7 @@ def load_engine(
         path = os.path.join(artifacts, f"entity-index-{re_type}.json")
         if os.path.exists(path):
             entity_indexes[re_type] = EntityIndex.load(path)
-    model = load_game_model(
+    model = load_resolved_game_model(
         model_dir, index_maps, entity_indexes, to_device=False
     )
     return ServingEngine(

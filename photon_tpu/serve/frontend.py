@@ -155,6 +155,42 @@ def score_jsonl(body: bytes, submit, result_timeout_s: Optional[float] = None):
     return out
 
 
+def apply_feedback(engine, body: dict) -> dict:
+    """``/v1/feedback`` core, shared by both deployment shapes: ``body`` is
+    one ``{"uid", "label", "ts"?}`` object or ``{"labels": [...]}`` for a
+    batch. Each item completes the feedback spool's label join for a
+    previously scored request; items whose uid already aged out of the join
+    window are counted as ``dropped``, not errors. Raises ``ValueError``
+    (→ 400) when the engine has no spool attached or an item is malformed."""
+    if not isinstance(body, dict):
+        raise ValueError("feedback body must be a JSON object")
+    items = body.get("labels")
+    if items is None:
+        items = [body]
+    if not isinstance(items, list):
+        raise ValueError("'labels' must be a list of {uid, label} objects")
+    joined = 0
+    dropped = 0
+    for item in items:
+        if (
+            not isinstance(item, dict)
+            or "uid" not in item
+            or "label" not in item
+        ):
+            raise ValueError("each feedback item needs 'uid' and 'label'")
+        ts = item.get("ts")
+        ok = engine.feedback_label(
+            str(item["uid"]),
+            float(item["label"]),
+            float(ts) if ts is not None else None,
+        )
+        if ok:
+            joined += 1
+        else:
+            dropped += 1
+    return {"joined": joined, "dropped": dropped}
+
+
 # ---------------------------------------------------------------------------
 # Framed IPC
 # ---------------------------------------------------------------------------
@@ -298,6 +334,11 @@ class ScorerServer:
                     target=self._op_reload, args=(rid, msg, out),
                     name="scorer-reload", daemon=True,
                 ).start()
+            elif op == "feedback":
+                out.put(dict(
+                    id=rid, ok=True,
+                    result=apply_feedback(self.engine, msg.get("body") or {}),
+                ))
             elif op == "ping":
                 out.put(dict(id=rid, ok=True, result="pong"))
             else:
@@ -549,6 +590,9 @@ class LocalBackend:
         )
         return self.engine.reload(model, body.get("modelVersion") or model_dir)
 
+    def feedback(self, body: dict) -> dict:
+        return apply_feedback(self.engine, body)
+
 
 class RemoteBackend:
     """Scorer access over the IPC channel — the worker deployment shape."""
@@ -580,6 +624,9 @@ class RemoteBackend:
             modelDir=body.get("modelDir"),
             modelVersion=body.get("modelVersion"),
         )
+
+    def feedback(self, body: dict) -> dict:
+        return self.client.call("feedback", timeout_s=30.0, body=body)
 
 
 def make_http_handler(backend):
@@ -648,6 +695,10 @@ def make_http_handler(backend):
                 elif self.path == "/v1/reload":
                     body = self._body()
                     info = backend.reload(json.loads(body) if body else {})
+                    self._reply_json(200, info)
+                elif self.path == "/v1/feedback":
+                    body = self._body()
+                    info = backend.feedback(json.loads(body) if body else {})
                     self._reply_json(200, info)
                 else:
                     self._reply_json(404, {"error": f"no route {self.path}"})

@@ -47,12 +47,14 @@ import numpy as np
 
 from photon_tpu.data.random_effect import bucket_dim
 from photon_tpu.data.residency import SlotLru
+from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.game import (
     FixedEffectModel,
     GameModel,
     ProjectedRandomEffectModel,
     RandomEffectModel,
 )
+from photon_tpu.models.glm import GeneralizedLinearModel
 from photon_tpu.obs.metrics import registry
 from photon_tpu.utils import faults, resources
 
@@ -696,6 +698,183 @@ class HotColdEntityStore:
                 jax.block_until_ready(coord.dev_entity_block)
                 for table in coord.tables:
                     jax.block_until_ready(table)
+
+    # -- delta overlay -----------------------------------------------------
+
+    def clone_with_delta(
+        self,
+        re_rows: Dict[str, tuple],
+        fixed: Optional[Dict[str, np.ndarray]] = None,
+    ) -> "HotColdEntityStore":
+        """A NEW store serving base ⊕ delta without reloading the base
+        model: per-entity coefficient rows (``re_rows``: cid → (idx, rows),
+        the shape ``io/model_io.py:read_delta_rows`` returns) overlay copies
+        of the touched host masters, and fixed-effect means (``fixed``:
+        cid → (d,) array) replace the base means value-only — the scoring
+        pytree structure is unchanged, so a transformer warmed on the base
+        scores the clone without a retrace.
+
+        Sharing discipline: entity indexes, RE submodel metadata, projected
+        groups, and every UNTOUCHED dense group are shared with the base
+        store, hot cache included — safe because untouched host masters are
+        byte-identical and the engine serializes every resolve/upload under
+        one batch lock. Touched groups get copied hosts; pinned tables are
+        rewritten by one functional bucketed scatter per coordinate (the
+        base version's tables are never mutated — multi-version residency
+        holds), unpinned groups restart cold with fresh tables + LRU and
+        refill on demand from the patched master.
+
+        Raises ValueError when the delta cannot be applied in place —
+        unknown coordinate, projected coordinate, feature-dim mismatch, or
+        an entity index outside the base entity space (the delta grew the
+        entity set). Callers treat that as "fall back to a full
+        resolved-model load".
+        """
+        import jax
+
+        re_rows = re_rows or {}
+        fixed = fixed or {}
+        proj_cids = {
+            c.cid for proj in self._proj_groups.values() for c in proj.coords
+        }
+        group_of: Dict[str, _ReGroup] = {
+            cid: g for g in self._groups.values() for cid in g.coord_ids
+        }
+        for cid, (idx, rows) in re_rows.items():
+            if cid in proj_cids:
+                raise ValueError(
+                    f"delta touches projected coordinate {cid!r}; in-place "
+                    "apply supports dense random effects only"
+                )
+            group = group_of.get(cid)
+            if group is None:
+                raise ValueError(
+                    f"delta coordinate {cid!r} is not a random-effect "
+                    "coordinate of the base model"
+                )
+            idx = np.asarray(idx)
+            rows = np.asarray(rows, np.float32)
+            host = group.host_coefs[cid]
+            if rows.ndim != 2 or rows.shape[1] != host.shape[1]:
+                raise ValueError(
+                    f"delta rows for {cid!r} have width "
+                    f"{rows.shape[1] if rows.ndim == 2 else rows.shape}, "
+                    f"base table has {host.shape[1]}"
+                )
+            if int(idx.shape[0]) != int(rows.shape[0]):
+                raise ValueError(
+                    f"delta for {cid!r}: {idx.shape[0]} indices vs "
+                    f"{rows.shape[0]} rows"
+                )
+            if idx.size and (
+                int(idx.min()) < 0 or int(idx.max()) >= group.num_entities
+            ):
+                raise ValueError(
+                    f"delta for {cid!r} addresses entities outside the base "
+                    f"entity space [0, {group.num_entities}) — the delta "
+                    "grew the entity set"
+                )
+        for cid, means in fixed.items():
+            sub = self._base.get(cid)
+            if not isinstance(sub, FixedEffectModel):
+                raise ValueError(
+                    f"delta fixed effect {cid!r} is not a fixed-effect "
+                    "coordinate of the base model"
+                )
+            means = np.asarray(means, np.float32)
+            old = np.asarray(sub.model.coefficients.means)
+            if means.shape != old.shape:
+                raise ValueError(
+                    f"delta fixed effect {cid!r} has shape {means.shape}, "
+                    f"base has {old.shape}"
+                )
+
+        new = object.__new__(HotColdEntityStore)
+        new._entity_indexes = self._entity_indexes
+        new._re_subs = self._re_subs
+        new._proj_groups = self._proj_groups
+        base = dict(self._base)
+        for cid, means in fixed.items():
+            sub = base[cid]
+            coefs = sub.model.coefficients
+            base[cid] = FixedEffectModel(
+                model=GeneralizedLinearModel(
+                    Coefficients(
+                        jax.device_put(np.asarray(means, np.float32)),
+                        coefs.variances,
+                    ),
+                    sub.model.task,
+                ),
+                feature_shard=sub.feature_shard,
+            )
+        new._base = base
+        groups: Dict[str, _ReGroup] = {}
+        for re_type, group in self._groups.items():
+            touched = {
+                cid: re_rows[cid] for cid in group.coord_ids if cid in re_rows
+            }
+            if not touched:
+                groups[re_type] = group
+                continue
+            host2: Dict[str, np.ndarray] = {}
+            for cid in group.coord_ids:
+                if cid in touched:
+                    idx, rows = touched[cid]
+                    h = group.host_coefs[cid].copy()
+                    h[np.asarray(idx, np.int64)] = np.asarray(
+                        rows, np.float32
+                    )
+                    host2[cid] = h
+                else:
+                    host2[cid] = group.host_coefs[cid]
+            g2 = _ReGroup(
+                re_type=re_type,
+                coord_ids=list(group.coord_ids),
+                host_coefs=host2,
+                num_entities=group.num_entities,
+                capacity=group.capacity,
+                pinned=group.pinned,
+            )
+            if group.pinned:
+                tables: Dict[str, object] = {}
+                for cid in group.coord_ids:
+                    if cid not in touched:
+                        tables[cid] = group.tables[cid]
+                        continue
+                    idx, rows = touched[cid]
+                    idx = np.asarray(idx, np.int64)
+                    rows = np.asarray(rows, np.float32)
+                    m = int(idx.shape[0])
+                    m_b = bucket_dim(m)
+                    # capacity == num_entities when pinned: the filler
+                    # index is out of range and drops, like _upload's.
+                    pad_idx = np.full(m_b, group.capacity, np.int32)
+                    pad_idx[:m] = idx
+                    pad_rows = np.zeros((m_b, rows.shape[1]), np.float32)
+                    pad_rows[:m] = rows
+                    tables[cid] = _oom_contained(
+                        re_type,
+                        lambda t=group.tables[cid], i=pad_idx, r=pad_rows: (
+                            _scatter(t, i, r)
+                        ),
+                    )
+                g2.tables = tables
+            else:
+                g2.tables = {
+                    cid: jax.device_put(
+                        np.zeros(
+                            (g2.capacity, host2[cid].shape[1]), np.float32
+                        )
+                    )
+                    for cid in group.coord_ids
+                }
+                g2.lru = SlotLru(
+                    g2.capacity, on_demote=self._demote_counter(re_type)
+                )
+            groups[re_type] = g2
+        new._groups = groups
+        registry().counter("serve_store_delta_clones_total").inc()
+        return new
 
     # -- scoring model -----------------------------------------------------
 

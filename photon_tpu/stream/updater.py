@@ -1,0 +1,377 @@
+"""Continuous micro-generation updater: spool segments → warm-started
+per-entity solves → gated delta publishes.
+
+The consume half of the streaming freshness loop. A long-running
+:class:`StreamingUpdater` polls the feedback spool for sealed segments,
+batches their joined (features, label) records into an incremental update
+(``train/incremental.py`` — warm start from the parent generation, active-set
+per-entity solves, row-level merge), and publishes the result as a
+per-entity DELTA layer (``io/model_io.py:save_delta_model``) through the
+SAME validation gate and ``LATEST`` pointer full generations use. Serving
+picks micro-generations up through the unchanged rollout watcher.
+
+Consume-cursor discipline — the generation manifest IS the cursor. Each
+published micro-generation records ``stream.consumedThrough`` (the highest
+segment sequence it trained on) in its manifest, written durably BEFORE the
+gate can flip ``LATEST``. Crash-resume is therefore double-apply-free by
+construction:
+
+- killed before the flip → ``LATEST`` (and so the cursor) is unchanged; the
+  restarted updater reprocesses the same segments from the same parent,
+  deterministically producing the same model;
+- killed after the flip → the segments are recorded consumed and skipped.
+
+There is no second cursor file to drift out of sync with the model lineage.
+A gate-refused generation never moves the cursor (it is not in the
+``LATEST`` lineage), so its segments are retried next cycle.
+
+Fault site ``stream.consume`` fires once per consumed segment (labelled with
+the segment name) and once more labelled ``train`` before the solve — a
+``kill`` rule at the right call index crashes the updater mid-generation,
+which is exactly what the resume-equivalence tests exercise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.stream.spool import (
+    read_segment,
+    recover_orphan_parts,
+    sealed_segments,
+    segment_seq,
+)
+from photon_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+_CURSOR_KEY = "consumedThrough"
+
+
+@dataclasses.dataclass
+class StreamingUpdaterConfig:
+    """Everything one streaming updater needs besides the loaded index
+    artifacts. ``coordinate_configs`` / ``update_sequence`` / ``task`` are
+    the same objects the batch drivers use — the updater runs the same
+    estimator, just on spool-fed micro-batches."""
+
+    publish_root: str
+    spool_dir: str
+    task: object
+    coordinate_configs: Sequence
+    update_sequence: Sequence[str]
+    cadence_s: float = 5.0
+    # Don't bother solving for fewer joined records than this; the segments
+    # stay unconsumed and accumulate into the next cycle.
+    min_records: int = 8
+    max_segments_per_cycle: int = 64
+    locked_coordinates: Sequence[str] = ()
+    # Publish per-entity delta layers (full publish is the fallback when a
+    # layer is not emittable). ``full_every=k`` forces every k-th publish to
+    # be full, bounding delta-chain length; 0 never forces.
+    delta_artifacts: bool = True
+    full_every: int = 0
+    # Every k-th record (deterministically) is held out for the gate's
+    # regression bound instead of trained on; 0 disables holdout scoring.
+    holdout_fraction: float = 0.0
+    evaluators: Sequence[str] = ("AUC",)
+    metric_tolerance: float = 0.02
+    norm_drift_bound: float = 10.0
+    num_iterations: int = 1
+    re_convergence_tol: float = 1e-4
+
+
+@dataclasses.dataclass
+class CycleResult:
+    """One ``run_once`` outcome (None is returned instead when there was
+    nothing to consume)."""
+
+    generation: str
+    published: bool
+    is_delta: bool
+    gate_reason: Optional[str]
+    segments: List[str]
+    records: int
+    consumed_through: int
+    staleness_s: Optional[float]
+
+
+def records_to_batch(records: List[dict], index_maps: Dict,
+                     entity_indexes: Dict, intern: bool = True):
+    """Joined spool records → one training GameBatch. Features densify
+    exactly like the serving engine's request assembly (string keys through
+    the shard's index map, intercept column set when the map has one), so
+    the updater trains on the same vectors serving scored. New entity ids
+    intern append-only into ``entity_indexes`` — existing slots never move.
+    """
+    import jax.numpy as jnp
+
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.index_map import IndexMap
+
+    n = len(records)
+    shard_dims = {shard: len(imap) for shard, imap in index_maps.items()}
+    icpt = {
+        shard: imap.get_index(IndexMap.INTERCEPT)
+        if IndexMap.INTERCEPT in imap else -1
+        for shard, imap in index_maps.items()
+    }
+    feats = {
+        shard: np.zeros((n, d), np.float32) for shard, d in shard_dims.items()
+    }
+    eids = {
+        re_type: np.full(n, -1, np.int64) for re_type in entity_indexes
+    }
+    label = np.zeros(n, np.float32)
+    offset = np.zeros(n, np.float32)
+    for i, rec in enumerate(records):
+        label[i] = float(rec.get("label") or 0.0)
+        offset[i] = float(rec.get("offset") or 0.0)
+        for shard, d in shard_dims.items():
+            row = feats[shard][i]
+            j = icpt[shard]
+            if j >= 0:
+                row[j] = 1.0
+            val = (rec.get("features") or {}).get(shard)
+            if val is None:
+                continue
+            if isinstance(val, dict):
+                imap = index_maps[shard]
+                for k, v in val.items():
+                    col = imap.get_index(k) if k in imap else -1
+                    if 0 <= col < d:
+                        row[col] = float(v)
+            elif (isinstance(val, (list, tuple)) and len(val) == 2
+                  and isinstance(val[0], (list, tuple))):
+                idx = np.asarray(val[0], np.int64)
+                vals = np.asarray(val[1], np.float32)
+                ok = (idx >= 0) & (idx < d)
+                row[idx[ok]] = vals[ok]
+            else:
+                arr = np.asarray(val, np.float32)
+                if arr.shape != (d,):
+                    raise ValueError(
+                        f"spool record {i}: shard {shard!r} expects ({d},), "
+                        f"got {arr.shape}"
+                    )
+                row[:] = arr
+        for re_type, eidx in entity_indexes.items():
+            key = (rec.get("entityIds") or {}).get(re_type)
+            if key is None:
+                continue
+            if isinstance(key, str):
+                eids[re_type][i] = (
+                    eidx.intern(key) if intern else eidx.lookup(key)
+                )
+            else:
+                eids[re_type][i] = int(key)
+    return GameBatch(
+        label=jnp.asarray(label),
+        offset=jnp.asarray(offset),
+        weight=jnp.ones(n, jnp.float32),
+        features={s: jnp.asarray(a) for s, a in feats.items()},
+        entity_ids={t: jnp.asarray(a, jnp.int32) for t, a in eids.items()},
+    )
+
+
+class StreamingUpdater:
+    """Spool-consuming micro-generation publisher over one publish root."""
+
+    def __init__(
+        self,
+        config: StreamingUpdaterConfig,
+        index_maps: Dict,
+        entity_indexes: Dict,
+    ):
+        self.config = config
+        self.index_maps = index_maps
+        self.entity_indexes = entity_indexes
+        self._cycles = 0
+        self._publishes = 0
+        self._stop = threading.Event()
+
+    # -- cursor ------------------------------------------------------------
+
+    def consumed_through(self) -> int:
+        """Highest spool segment sequence already folded into the published
+        model lineage: walk parent links from ``LATEST`` and return the
+        first ``stream.consumedThrough`` found. A full (batch) publish
+        interleaved into the lineage carries no stream record and is walked
+        through — its parent chain still reaches the last streaming
+        generation."""
+        from photon_tpu.cli.game_serving import resolve_model_dir
+        from photon_tpu.io.model_io import load_generation_manifest
+
+        root = self.config.publish_root
+        cur = resolve_model_dir(root)
+        if cur == root:
+            return 0
+        for _ in range(128):
+            manifest = load_generation_manifest(cur) or {}
+            stream = manifest.get("stream") or {}
+            if _CURSOR_KEY in stream:
+                return int(stream[_CURSOR_KEY])
+            parent = manifest.get("parent")
+            if not parent:
+                return 0
+            cur = os.path.join(root, parent)
+            if not os.path.isdir(cur):
+                return 0
+        return 0
+
+    # -- one cycle ---------------------------------------------------------
+
+    def run_once(self) -> Optional[CycleResult]:
+        """Consume pending sealed segments into one gated micro-generation.
+        Returns None when there is nothing (or not yet enough) to train on.
+        """
+        from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
+        from photon_tpu.obs.metrics import registry
+        from photon_tpu.train.incremental import incremental_update
+
+        cfg = self.config
+        recover_orphan_parts(cfg.spool_dir)
+        cursor = self.consumed_through()
+        pending = [
+            fn for fn in sealed_segments(cfg.spool_dir)
+            if segment_seq(fn) > cursor
+        ][: cfg.max_segments_per_cycle]
+        if not pending:
+            return None
+        records: List[dict] = []
+        for fn in pending:
+            faults.check("stream.consume", label=fn)
+            records.extend(read_segment(os.path.join(cfg.spool_dir, fn)))
+        if len(records) < cfg.min_records:
+            return None
+        self._cycles += 1
+        reg = registry()
+        reg.counter("stream_cycles_total").inc()
+
+        # Deterministic holdout split: every k-th record scores the gate's
+        # regression bound instead of training. Determinism matters — a
+        # crashed-and-restarted cycle must rebuild the identical split.
+        train_recs, holdout_recs = records, []
+        if cfg.holdout_fraction > 0.0:
+            k = max(2, int(round(1.0 / cfg.holdout_fraction)))
+            train_recs = [r for i, r in enumerate(records) if i % k != 0]
+            holdout_recs = [r for i, r in enumerate(records) if i % k == 0]
+            if not train_recs:
+                train_recs, holdout_recs = records, []
+
+        faults.check("stream.consume", label="train")
+        batch = records_to_batch(
+            train_recs, self.index_maps, self.entity_indexes, intern=True
+        )
+        valid_batch = None
+        suite = None
+        if holdout_recs:
+            valid_batch = records_to_batch(
+                holdout_recs, self.index_maps, self.entity_indexes,
+                intern=False,
+            )
+            suite = EvaluationSuite(
+                [EvaluatorSpec.parse(e) for e in cfg.evaluators],
+                {k: len(v) for k, v in self.entity_indexes.items()},
+            )
+
+        consumed = max(segment_seq(fn) for fn in pending)
+        label_ts = [
+            float(r["labelTs"]) for r in records if r.get("labelTs")
+        ]
+        oldest_label_ts = min(label_ts) if label_ts else None
+        emit_delta = bool(cfg.delta_artifacts)
+        if emit_delta and cfg.full_every > 0:
+            emit_delta = (self._publishes + 1) % cfg.full_every != 0
+        stream_info = {
+            _CURSOR_KEY: consumed,
+            "segments": pending,
+            "records": len(records),
+        }
+        if oldest_label_ts is not None:
+            stream_info["oldestLabelTs"] = oldest_label_ts
+
+        result = incremental_update(
+            cfg.publish_root,
+            batch,
+            self.index_maps,
+            self.entity_indexes,
+            cfg.task,
+            cfg.coordinate_configs,
+            cfg.update_sequence,
+            valid_batch=valid_batch,
+            evaluation_suite=suite,
+            locked_coordinates=list(cfg.locked_coordinates),
+            num_iterations=cfg.num_iterations,
+            metric_tolerance=cfg.metric_tolerance,
+            norm_drift_bound=cfg.norm_drift_bound,
+            re_convergence_tol=cfg.re_convergence_tol,
+            emit_delta=emit_delta,
+            extra_manifest={"stream": stream_info},
+        )
+        reg.counter("stream_records_consumed_total").inc(len(records))
+        staleness = None
+        if result.published:
+            self._publishes += 1
+            reg.counter("stream_publishes_total").inc()
+            if oldest_label_ts is not None:
+                staleness = time.time() - oldest_label_ts
+                reg.gauge("model_staleness_published_s").set(staleness)
+        else:
+            reg.counter("stream_gate_rejects_total").inc()
+            logger.warning(
+                "streaming generation %s refused by the gate (%s); segments "
+                "through %d stay unconsumed and retry next cycle",
+                result.generation, result.gate_reason, consumed,
+            )
+        return CycleResult(
+            generation=result.generation,
+            published=result.published,
+            is_delta=result.is_delta,
+            gate_reason=result.gate_reason,
+            segments=pending,
+            records=len(records),
+            consumed_through=consumed,
+            staleness_s=staleness,
+        )
+
+    # -- driver loop -------------------------------------------------------
+
+    def run_forever(self, max_cycles: Optional[int] = None) -> int:
+        """Poll-train-publish until :meth:`stop` (or ``max_cycles``
+        publishes/attempts). Solver or IO failures inside one cycle are
+        contained and counted — the loop survives to retry with the same
+        unconsumed segments."""
+        from photon_tpu.obs.metrics import registry
+
+        done = 0
+        while not self._stop.is_set():
+            try:
+                result = self.run_once()
+            except Exception:  # noqa: BLE001 — cycle containment
+                registry().counter("stream_cycle_failures_total").inc()
+                logger.exception("streaming update cycle failed; retrying")
+                result = None
+            if result is not None:
+                done += 1
+                if max_cycles is not None and done >= max_cycles:
+                    break
+            self._stop.wait(self.config.cadence_s)
+        return done
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def stats(self) -> dict:
+        return {
+            "cycles": self._cycles,
+            "publishes": self._publishes,
+            "consumed_through": self.consumed_through(),
+        }

@@ -197,6 +197,7 @@ class IncrementalResult:
     holdout_metrics: Dict[str, float]
     changed_entities: Dict[str, int]
     parent: Optional[str]
+    is_delta: bool = False
 
 
 def incremental_update(
@@ -220,6 +221,8 @@ def incremental_update(
     re_spill_dir: Optional[str] = None,
     dead_letters: Optional[List[dict]] = None,
     publish: bool = True,
+    emit_delta: bool = False,
+    extra_manifest: Optional[dict] = None,
 ) -> IncrementalResult:
     """One incremental generation, end to end: warm-start train on the
     delta ``batch`` → merge over the parent → save → manifest → gate →
@@ -229,13 +232,21 @@ def incremental_update(
 
     ``sparsity_threshold`` defaults to 0 (exact round trip): an incremental
     chain re-loads its own output as the next warm start, and thresholding
-    would decay coefficients a little every generation."""
+    would decay coefficients a little every generation.
+
+    ``emit_delta=True`` persists the generation as a per-entity DELTA layer
+    over the parent (only changed rows written; the resolved chain is
+    bit-identical to a full publish) — the streaming updater's micro-
+    generation artifact. Falls back to a full publish when there is no
+    parent or nothing qualifies for a layer. ``extra_manifest`` merges extra
+    keys into the generation manifest (e.g. the stream consume cursor)."""
     from photon_tpu.cli.game_serving import resolve_model_dir
     from photon_tpu.estimators.game_estimator import GameEstimator
     from photon_tpu.io.model_io import (
+        allocate_generation,
         gate_and_publish,
-        load_game_model,
-        next_generation_name,
+        load_resolved_game_model,
+        save_delta_model,
         save_game_model,
         write_generation_manifest,
     )
@@ -245,7 +256,12 @@ def incremental_update(
     parent_name = os.path.basename(parent_dir.rstrip("/")) if has_parent else None
     parent = None
     if has_parent:
-        parent = load_game_model(parent_dir, index_maps, entity_indexes)
+        # Delta-aware: a streaming parent can itself be a delta layer; the
+        # warm start must be the RESOLVED model, not the layer's few rows.
+        parent = load_resolved_game_model(
+            parent_dir, index_maps, entity_indexes, to_device=True,
+            publish_root=publish_root,
+        )
 
     num_entities = {k: len(v) for k, v in entity_indexes.items()}
     changed_masks = {
@@ -291,12 +307,41 @@ def incremental_update(
     if valid_batch is not None and evaluation_suite is not None:
         holdout = compute_holdout_metrics(merged, valid_batch, evaluation_suite)
 
-    generation = generation or next_generation_name(publish_root)
+    # Allocation is flock-serialized: concurrent updaters (batch + streaming,
+    # or two streaming workers) must never claim the same generation id.
+    generation = generation or allocate_generation(publish_root)
     model_dir = os.path.join(publish_root, generation)
-    save_game_model(
-        merged, model_dir, index_maps, entity_indexes,
-        sparsity_threshold=sparsity_threshold,
-    )
+    is_delta = False
+    if emit_delta and parent is not None:
+        # Every RE coordinate needs a mask; a coordinate whose re_type the
+        # delta batch never mentioned changed nowhere (merge kept the parent
+        # rows verbatim), so it contributes no rows to the layer.
+        save_masks = dict(changed_masks)
+        for sub in merged.models.values():
+            if isinstance(sub, RandomEffectModel):
+                save_masks.setdefault(
+                    sub.re_type,
+                    np.zeros((np.asarray(sub.coefficients).shape[0],), bool),
+                )
+        fe_cids = [
+            cid for cid, sub in merged.models.items()
+            if isinstance(sub, FixedEffectModel)
+        ]
+        include_fixed = any(c not in locked_coordinates for c in fe_cids)
+        try:
+            save_delta_model(
+                merged, save_masks, model_dir, index_maps, entity_indexes,
+                base=parent_name, sparsity_threshold=sparsity_threshold,
+                include_fixed=include_fixed,
+            )
+            is_delta = True
+        except ValueError as exc:
+            logger.info("delta layer not emittable (%s); publishing full", exc)
+    if not is_delta:
+        save_game_model(
+            merged, model_dir, index_maps, entity_indexes,
+            sparsity_threshold=sparsity_threshold,
+        )
     # Entity indexes grew with the delta's new entities; persist them BEFORE
     # the pointer can move so a reloading server resolves every slot the new
     # generation references. (Interning is append-only: existing slots are
@@ -308,6 +353,8 @@ def incremental_update(
     extra = {"changedEntities": changed_counts}
     if dead_letters:
         extra["deadLetterChunks"] = dead_letters
+    if extra_manifest:
+        extra.update(extra_manifest)
     write_generation_manifest(
         model_dir, parent=parent_name, holdout_metrics=holdout, extra=extra
     )
@@ -328,4 +375,5 @@ def incremental_update(
         holdout_metrics=holdout,
         changed_entities=changed_counts,
         parent=parent_name,
+        is_delta=is_delta,
     )

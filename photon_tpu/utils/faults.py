@@ -9,7 +9,11 @@ injector with named hook points in the ingest pipeline
 (``solve.fe``/``solve.re_block``), the checkpoint writer
 (``checkpoint.save``/``checkpoint.after_save``), and the serving store/engine
 (``serve.store_resolve``/``serve.store_upload``/``serve.score``/
-``serve.reload``).
+``serve.reload``), and the streaming freshness loop
+(``serve.feedback`` — the spool's label-join/segment writer, where ``torn``
+tears the active segment mid-record and ``enospc`` drops the join — and
+``stream.consume`` — the updater's per-segment read and pre-train step,
+where ``kill`` crashes the updater mid-cycle).
 
 A **plan** is JSON — inline or a file path — selected by the
 ``PHOTON_TPU_FAULT_PLAN`` environment variable (or programmatically via
