@@ -681,7 +681,7 @@ def run_rmatvec_cpu_ab() -> dict:
         walls[f"rmatvec_{variant}_wall_s"] = round(min(times), 4)
         if best is None or min(times) < best[0]:
             best = (min(times), variant)
-    from photon_tpu.data.batch import DEFAULT_TRANSPOSE_PLAN
+    from photon_tpu.data.batch import default_transpose_plan
 
     return dict(
         metric="rmatvec_cpu_ab_best_wall_s",
@@ -693,7 +693,8 @@ def run_rmatvec_cpu_ab() -> dict:
         nnz_per_row=_RM_K,
         iters=_RM_ITERS,
         host_cores=_available_cores(),
-        default_transpose_plan=DEFAULT_TRANSPOSE_PLAN,
+        backend=jax.default_backend(),
+        default_transpose_plan=default_transpose_plan(),
         **walls,
     )
 

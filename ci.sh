@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|rollout|streaming|exhaustion|install|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|rollout|streaming|exhaustion|install|kernels|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -534,6 +534,39 @@ run_exhaustion() {
     echo "   exhaustion-soak smoke OK"
 }
 
+run_kernels() {
+    # Kernel-surface smoke: interpret-mode parity for both Pallas kernel
+    # families (FE fused value+grad/HVP, RE batched Newton system), and a
+    # dead-code gate — the round-4 FE A/B DELETED the losing lowerings, so
+    # their per-call tile_n override must stay gone from the public
+    # signatures (no quietly resurrected code paths in ops/pallas_glm.py).
+    echo "== kernels: FE/RE Pallas parity smokes + deleted-lowering gate =="
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import inspect
+
+from photon_tpu.ops.pallas_glm import (
+    fused_data_hvp,
+    fused_data_value_and_grad,
+)
+from photon_tpu.ops.pallas_newton import fused_newton_system
+
+for fn in (fused_data_value_and_grad, fused_data_hvp):
+    params = inspect.signature(fn).parameters
+    assert "tile_n" not in params, (
+        f"{fn.__name__} grew a tile_n override back — the losing FE "
+        "lowerings were deleted in the round-4 A/B (BENCH_FULL.md)"
+    )
+print("   deleted-lowering gate OK (no tile_n in public signatures)")
+EOF
+    JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+        tests/test_pallas_glm.py \
+        tests/test_re_kernel.py::test_fused_newton_system_bitexact_unbatched_and_vmapped \
+        "tests/test_re_kernel.py::test_solve_block_pallas_bitexact_mixed_geometries[False]" \
+        tests/test_re_kernel.py::test_solve_block_bf16x_pinned_tolerance \
+        tests/test_re_kernel.py::test_zero_post_warmup_retraces
+    echo "   kernels smoke OK"
+}
+
 run_install() {
     echo "== packaging: editable install + console entry points =="
     tmp="$(mktemp -d)"
@@ -571,7 +604,8 @@ case "$stage" in
     streaming) run_streaming ;;
     exhaustion) run_exhaustion ;;
     install) run_install ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_rollout; run_streaming; run_exhaustion; run_unit ;;
+    kernels) run_kernels ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_rollout; run_streaming; run_exhaustion; run_kernels; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

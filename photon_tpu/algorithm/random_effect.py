@@ -183,6 +183,7 @@ def _solve_block(
     spec: OptimizerSpec,
     config: OptimizerConfig,
     feature_mask: Optional[Array] = None,  # (E, d) 0/1 Pearson mask
+    re_kernel: str = "xla",
 ):
     """vmap one optimizer over all entities of a block. Returns (E, d) coefs +
     per-entity (iterations, reason) for the tracker.
@@ -194,6 +195,13 @@ def _solve_block(
     vs the reference's per-entity Breeze L-BFGS inside mapValues,
     RandomEffectCoordinate.scala:228-283), with margin-space L-BFGS as the
     wide-d / feature-masked / shift-normalized fallback.
+
+    ``re_kernel`` (already resolved, never "auto") selects the Newton-system
+    assembly lowering for the Newton route only — "pallas"/"pallas_bf16x"
+    fuse the Hessian + gradient reductions into one Pallas read of each
+    entity's slab, batched by this function's vmap into one grid instance
+    per block row (ops/pallas_newton). Non-Newton routes (OWL-QN, TRON,
+    margin-L-BFGS fallbacks) ignore it.
     """
     use_newton = newton_eligible(
         objective, spec, block.dim, has_mask=feature_mask is not None
@@ -231,7 +239,7 @@ def _solve_block(
                 l1_mask = jnp.ones_like(w_init).at[objective.intercept_index].set(0.0)
             res = minimize_owlqn(vg, w_start, objective.l1_weight, config, l1_mask)
         elif use_newton:
-            res = minimize_newton(objective, lb, w_start, config)
+            res = minimize_newton(objective, lb, w_start, config, kernel=re_kernel)
         elif spec.optimizer == OptimizerType.TRON:
             res = minimize_tron(
                 vg, None, w_start, config, spec.max_cg_iter,
@@ -308,9 +316,19 @@ class RandomEffectCoordinate(Coordinate):
     # algorithm/re_store.ReDeviceStore. None → fully resident (default).
     device_budget_bytes: Optional[int] = None
     device_spill_dir: Optional[str] = None
+    # Newton-system assembly lowering for the per-entity solves
+    # (ops/pallas_newton.RE_KERNELS): "auto" picks the fused batched Pallas
+    # kernel on a real TPU backend and XLA elsewhere; "pallas" /
+    # "pallas_bf16x" force the fused kernel (interpret mode off-TPU — the
+    # CPU parity/bench path); "xla" forces the two-read einsum lowering.
+    # Part of the solver-cache key, so variants never share executables.
+    re_kernel: str = "auto"
 
     def __post_init__(self):
         self.compute_variance = normalize_variance_type(self.compute_variance)
+        from photon_tpu.ops.pallas_newton import resolve_re_kernel
+
+        self._re_kernel = resolve_re_kernel(self.re_kernel)
         if self.solve_cache is None:
             self.solve_cache = default_cache()
         # Per-entity solves keep only aggregate tracker stats (HBM budget).
@@ -708,6 +726,7 @@ class RandomEffectCoordinate(Coordinate):
                 solver = self.solve_cache.block_solver(
                     obj, self.optimizer_spec, self._config,
                     has_mask=mask is not None, convergence_tol=tol,
+                    re_kernel=self._re_kernel,
                 )
                 if gated and self.solve_cache.max_entries is None:
                     # Compacted shapes were all compiled during the full
@@ -917,6 +936,7 @@ class RandomEffectCoordinate(Coordinate):
                     solver = self.solve_cache.block_solver(
                         obj, self.optimizer_spec, self._config,
                         has_mask=mask is not None, convergence_tol=tol,
+                        re_kernel=self._re_kernel,
                     )
                     store.mark_solve_start()
                     if gated and self.solve_cache.max_entries is None:
@@ -1010,6 +1030,7 @@ class RandomEffectCoordinate(Coordinate):
                 solver = self.solve_cache.block_solver(
                     obj, self.optimizer_spec, self._config,
                     has_mask=mask is not None, convergence_tol=tol,
+                    re_kernel=self._re_kernel,
                 )
                 if gated and self.solve_cache.max_entries is None:
                     with self.solve_cache.expect_cached(

@@ -23,15 +23,25 @@ import numpy as np
 
 Array = jax.Array
 
-# Measured default for the padded-sparse rmatvec lowering at the ingest
-# boundary (FeatureShardConfig.transpose_plan=None resolves to this).
-# Head-to-head on this image's CPU mesh (bench.py --rmatvec-cpu-ab,
-# BENCH_FULL.md): the duplicate-index scatter-add beat the column-sorted
-# segment_sum, so no transpose plan is attached by default. XLA:TPU
-# serializes colliding scatter updates, so re-run the A/B (and
-# run_sparse_wide at full scale) on real hardware before trusting this
-# default there.
-DEFAULT_TRANSPOSE_PLAN = False
+# Per-backend defaults for the padded-sparse rmatvec lowering at the ingest
+# boundary (FeatureShardConfig.transpose_plan=None resolves through
+# ``default_transpose_plan()``). CPU: measured head-to-head on this image's
+# CPU mesh (bench.py --rmatvec-cpu-ab, BENCH_FULL.md) — the duplicate-index
+# scatter-add beat the column-sorted segment_sum, so no plan is attached.
+# TPU: segment-sum is the native lowering (XLA:TPU serializes colliding
+# scatter updates, so the scatter path degenerates under index collisions);
+# pinned True pending the on-chip re-run of the A/B at full run_sparse_wide
+# scale — the CPU number does not transfer.
+_TRANSPOSE_PLAN_CPU = False
+_TRANSPOSE_PLAN_TPU = True
+
+
+def default_transpose_plan() -> bool:
+    """Backend-aware rmatvec-plan default, resolved LAZILY at dataset build
+    / read time (a module-level constant would bake in whichever backend
+    imported first and silently ship the CPU-measured winner to TPU)."""
+    return _TRANSPOSE_PLAN_TPU if jax.default_backend() == "tpu" \
+        else _TRANSPOSE_PLAN_CPU
 
 
 @jax.tree_util.register_pytree_node_class

@@ -58,17 +58,18 @@ class FeatureShardConfig:
     dense_dim_limit: int = 4096
     # rmatvec lowering for padded-sparse shards: True attaches the
     # column-sorted transpose plan (segment_sum), False keeps the
-    # duplicate-index scatter-add, None takes the measured backend default
-    # (data/batch.py::DEFAULT_TRANSPOSE_PLAN, set by bench.py
-    # --rmatvec-cpu-ab / run_sparse_wide head-to-heads).
+    # duplicate-index scatter-add, None takes the backend-aware measured
+    # default (data/batch.py::default_transpose_plan — scatter on CPU per
+    # bench.py --rmatvec-cpu-ab, segment-sum on TPU where XLA serializes
+    # colliding scatter updates).
     transpose_plan: Optional[bool] = None
 
     @property
     def resolved_transpose_plan(self) -> bool:
-        from photon_tpu.data.batch import DEFAULT_TRANSPOSE_PLAN
+        from photon_tpu.data.batch import default_transpose_plan
 
         if self.transpose_plan is None:
-            return DEFAULT_TRANSPOSE_PLAN
+            return default_transpose_plan()
         return bool(self.transpose_plan)
 
 

@@ -60,7 +60,11 @@ class GLMObjective:
     # Route dense value_and_grad through the fused Pallas kernel (one HBM
     # pass over X instead of XLA's two; photon_tpu.ops.pallas_glm). Falls
     # back automatically where the kernel doesn't apply (sparse features,
-    # shift normalization, very wide dims).
+    # shift normalization, very wide dims). Since the round-4 FE bandwidth
+    # A/B (bench --fe-bandwidth-ab) there is exactly one fused lowering —
+    # tall rebalanced tiles on a sequential grid, fused one-pass HVP — and
+    # it is the default for every fuse-eligible evaluation here; the
+    # losing variants were deleted from pallas_glm, not kept behind flags.
     use_pallas: bool = dataclasses.field(default=False, metadata=dict(static=True))
 
     # ----- margins -----

@@ -25,6 +25,19 @@ shard_map path (photon_tpu.parallel.feature_sharded) applies.
 
 L2/normalization are folded by the wrapper (effective-coefficient algebra,
 photon_tpu.data.normalization), keeping the kernel a pure data-loss pass.
+
+Round-4 FE bandwidth verdict (bench ``--fe-bandwidth-ab``, BENCH_FULL.md):
+this file now holds exactly ONE lowering per entry point. The three
+round-4 candidates all survive as PARTS of it — tall rebalanced tiles
+(``_tile_geometry``), the fused one-pass HVP (``_hvp_kernel``), and the
+explicit sequential-grid declaration (``_SEQUENTIAL_GRID``, a correctness
+requirement on megacore parts, not a tunable) — while the losing
+alternatives were deleted rather than gated: the short-tile per-call
+``tile_n`` override is gone from both public signatures, and the
+linearize/transpose HVP in ops/objective.py remains only as the
+ineligibility fallback (sparse/wide/sharded), never a competing lowering
+for fuse-eligible batches. On-chip confirmation is pending the TPU tunnel
+(every number so far is CPU: interpret-mode parity + modeled traffic).
 """
 
 from __future__ import annotations
@@ -181,7 +194,6 @@ def fused_data_hvp(
     v: Array,
     X: Array,
     d2: Array,
-    tile_n: int = DEFAULT_TILE_N,
     interpret: Optional[bool] = None,
 ) -> Array:
     """Xᵀ·diag(d2)·X·v in ONE pass over ``X`` (vs two XLA passes for the
@@ -189,14 +201,22 @@ def fused_data_hvp(
     product at fixed margins; pairs with GLMObjective.linearized_hvp,
     which caches d2 once per outer iteration
     (HessianVectorAggregator.scala role). Padding is exact (zero rows /
-    columns contribute nothing)."""
+    columns contribute nothing).
+
+    Tile geometry is fixed by ``DEFAULT_TILE_N`` (module constant, read at
+    call time) — the round-4 FE bandwidth A/B kept the fused one-pass HVP
+    as the only HVP lowering and retired the per-call tile-height override
+    with the losing short-tile variants (BENCH_FULL.md, bench
+    ``--fe-bandwidth-ab``). Tests vary geometry by monkeypatching
+    ``pallas_glm.DEFAULT_TILE_N``.
+    """
     _require_pallas()
     n, d = X.shape
     _check_fused_width(d, "fused_data_hvp")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     d_pad = int(np.ceil(max(d, 1) / 128) * 128)
-    tile_n, n_pad = _tile_geometry(n, d_pad, X.dtype, tile_n)
+    tile_n, n_pad = _tile_geometry(n, d_pad, X.dtype, DEFAULT_TILE_N)
     if n_pad != n or d_pad != d:
         X = jnp.pad(X, ((0, n_pad - n), (0, d_pad - d)))
         d2 = jnp.pad(d2, (0, n_pad - n))
@@ -262,7 +282,6 @@ def fused_data_value_and_grad(
     label: Array,
     offset: Array,
     weight: Array,
-    tile_n: int = DEFAULT_TILE_N,
     interpret: Optional[bool] = None,
     return_margins: bool = False,
 ) -> Tuple[Array, ...]:
@@ -280,6 +299,13 @@ def fused_data_value_and_grad(
     ``z = X·w + offset`` (float32, shape (n,)) computed in the same pass —
     the margin-space L-BFGS uses this to refresh its carried margins exactly
     every iteration instead of accumulating ``z += α·u`` rounding drift.
+
+    Tile geometry is fixed by ``DEFAULT_TILE_N`` (module constant, read at
+    call time): the round-4 FE bandwidth A/B (bench ``--fe-bandwidth-ab``,
+    BENCH_FULL.md) settled on tall rebalanced tiles under a sequential
+    grid as the single surviving lowering, so the per-call tile-height
+    override was deleted with the losing candidates. Tests vary geometry
+    by monkeypatching ``pallas_glm.DEFAULT_TILE_N``.
     """
     _require_pallas()
     n, d = X.shape
@@ -288,7 +314,7 @@ def fused_data_value_and_grad(
         interpret = jax.default_backend() != "tpu"
 
     d_pad = int(np.ceil(max(d, 1) / 128) * 128)
-    tile_n, n_pad = _tile_geometry(n, d_pad, X.dtype, tile_n)
+    tile_n, n_pad = _tile_geometry(n, d_pad, X.dtype, DEFAULT_TILE_N)
     if n_pad != n or d_pad != d:
         X = jnp.pad(X, ((0, n_pad - n), (0, d_pad - d)))
         label = jnp.pad(label, (0, n_pad - n))

@@ -1,0 +1,186 @@
+"""Batched small-GLM Newton-system Pallas kernel for random effects.
+
+Role parity: the reference solves thousands of tiny per-entity GLMs inside
+``mapValues`` (photon-api algorithm/RandomEffectCoordinate.scala:228-283) —
+one Breeze optimizer per entity on whatever executor holds the partition.
+The TPU rebuild already collapses a bucket of entities into ONE vmapped
+damped-Newton program (optim/newton.py); this module collapses that
+program's X-touching work into a single Pallas kernel with **one grid
+instance per bucketed block row**: each instance streams its entity's
+(n_max, d) feature slab through VMEM once and assembles both Newton-system
+reductions in that single read —
+
+    per entity:  H = Xᵀ·diag(d2)·X     (MXU, d×d resident in VMEM)
+                 g = Xᵀ·dz             (MXU, d resident in VMEM)
+
+where the XLA lowering reads X twice (einsum Hessian + transpose matvec).
+The Cholesky factorization, the Levenberg damping loop, and the trial-point
+margin sweep stay in XLA — ``lax.linalg`` does not lower inside Mosaic, and
+keeping the loop structure identical to the XLA path is what makes parity
+bit-exact by construction (the kernel only replaces two reductions whose
+per-entity values are reduction-order-identical to the vmapped einsum /
+matmul; verified on CPU, pinned by tests/test_re_kernel.py).
+
+The kernel is written UNBATCHED (one entity) and batched by ``jax.vmap``
+inside ``_solve_block``'s ``vmap(solve_one)`` — pallas_call's batching rule
+prepends the entity grid dimension, which is exactly the "one grid instance
+per block row" shape, and it means every surrounding op (while_loop carry,
+convergence select, quarantine) is shared verbatim with the XLA path.
+
+bfloat16 X ("pallas_bf16x"): the kernel reads a bf16 copy of the slab
+(halving the bandwidth-bound HBM read) and upcasts in VMEM; d2/dz and ALL
+accumulation stay float32. Parity vs the f32 XLA path is then a pinned
+tolerance, not bit-exact — see RE_KERNELS below and the BENCH_FULL.md
+verdict table.
+
+On-chip status: this module compiles the padded/tiled lowering only on a
+real TPU backend (``padded=None`` auto). Every number and parity claim so
+far is CPU interpret-mode (the r3–r5 TPU tunnel wedge, BENCH_FULL.md); the
+on-chip run is pending.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.ops.pallas_glm import (  # noqa: F401  (re-exported gates)
+    _SEQUENTIAL_GRID,
+    _require_pallas,
+    _tile_geometry,
+    pallas_available,
+    pallas_usable,
+    pl,
+)
+
+Array = jax.Array
+
+# Solver-kernel routing values for RandomEffectCoordinate.re_kernel /
+# solve_cache.block_solver. "auto" resolves per backend; the other three are
+# concrete lowerings:
+#   xla          — vmapped einsum/matmul Newton system (2 X reads/iter)
+#   pallas       — fused one-read Pallas Newton system, f32 X (bit-exact)
+#   pallas_bf16x — same kernel over a bf16 X copy, f32 accumulate
+#                  (pinned-tolerance parity; halves the slab's HBM read)
+RE_KERNELS = ("auto", "xla", "pallas", "pallas_bf16x")
+
+
+def resolve_re_kernel(re_kernel: str) -> str:
+    """Concrete kernel for a requested routing value. ``auto`` picks the
+    fused Pallas lowering only where it runs at full speed (a real TPU
+    backend); everywhere else the XLA path wins — interpret-mode Pallas is
+    orders of magnitude slower than XLA on CPU, so auto must never select
+    it (tests and benches opt in explicitly)."""
+    if re_kernel not in RE_KERNELS:
+        raise ValueError(
+            f"re_kernel must be one of {RE_KERNELS}, got {re_kernel!r}"
+        )
+    if re_kernel == "auto":
+        return "pallas" if pallas_available() else "xla"
+    return re_kernel
+
+
+def _system_kernel(x_ref, d2_ref, dz_ref, h_ref, g_ref):
+    """Whole-slab instance: both reductions from one read of x_ref.
+
+    The einsum / matmul formulations are deliberately IDENTICAL to the XLA
+    path in optim/newton.py — under vmap their per-entity values are
+    bit-equal to the batched lowering (reduction-order parity verified on
+    CPU), which is what lets the fused path claim bit-exact results."""
+    x = x_ref[...]
+    if x.dtype != jnp.float32:
+        x = x.astype(jnp.float32)  # bf16 slab upcasts in VMEM; accum stays f32
+    h_ref[...] = jnp.einsum("nd,n,ne->de", x, d2_ref[...], x)
+    g_ref[...] = x.T @ dz_ref[...]
+
+
+def _system_kernel_tiled(x_ref, d2_ref, dz_ref, h_ref, g_ref):
+    """Row-tiled instance for slabs over the VMEM budget: sequential-grid
+    accumulation (the pallas_glm reduction pattern), rank-2 operands for
+    Mosaic layouts, preferred_element_type pins f32 accumulation."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        h_ref[:] = jnp.zeros_like(h_ref)
+        g_ref[:] = jnp.zeros_like(g_ref)
+
+    x = x_ref[:]
+    if x.dtype != jnp.float32:
+        x = x.astype(jnp.float32)
+    xd = x * d2_ref[:]  # (tile_n, d_pad) ∘ (tile_n, 1)
+    h_ref[:] += jax.lax.dot_general(
+        xd, x,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    g_ref[:] += jax.lax.dot_general(
+        x, dz_ref[:],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def fused_newton_system(
+    X: Array,
+    d2: Array,
+    dz: Array,
+    interpret: Optional[bool] = None,
+    padded: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """``(Xᵀ·diag(d2)·X, Xᵀ·dz)`` in ONE pass over ``X`` ((n, d), one
+    entity; vmap for the batched per-block-row kernel).
+
+    ``padded=None`` auto-selects: the exact unpadded whole-slab kernel in
+    interpret mode (CPU — bit-exact vs the XLA formulations), the
+    lane/sublane-padded tiled lowering when compiling for TPU (zero padding
+    rows/columns contribute exactly zero to both reductions, but tiling
+    re-associates the n-reduction, so on-chip parity is pinned-tolerance
+    like bf16 — see module docstring)."""
+    _require_pallas()
+    n, d = X.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if padded is None:
+        padded = not interpret
+    if not padded:
+        return pl.pallas_call(
+            _system_kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct((d, d), jnp.float32),
+                jax.ShapeDtypeStruct((d,), jnp.float32),
+            ],
+            interpret=interpret,
+        )(X, d2, dz)
+
+    d_pad = int(np.ceil(max(d, 1) / 128) * 128)
+    tile_n, n_pad = _tile_geometry(n, d_pad, X.dtype, n)
+    if n_pad != n or d_pad != d:
+        X = jnp.pad(X, ((0, n_pad - n), (0, d_pad - d)))
+        d2 = jnp.pad(d2, (0, n_pad - n))
+        dz = jnp.pad(dz, (0, n_pad - n))
+    col = lambda v: v.astype(jnp.float32)[:, None]  # noqa: E731
+    n_tiles = n_pad // tile_n
+    h, g = pl.pallas_call(
+        _system_kernel_tiled,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_n, d_pad), lambda i: (i, 0)),  # X row tile
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),      # d2
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),      # dz
+        ],
+        out_specs=[
+            pl.BlockSpec((d_pad, d_pad), lambda i: (0, 0)),
+            pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d_pad, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((d_pad, 1), jnp.float32),
+        ],
+        compiler_params=None if interpret else _SEQUENTIAL_GRID,
+        interpret=interpret,
+    )(X, col(d2), col(dz))
+    return h[:d, :d], g[:d, 0]

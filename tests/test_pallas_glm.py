@@ -9,6 +9,7 @@ from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.normalization import NormalizationContext
 from photon_tpu.ops.losses import LogisticLoss, PoissonLoss, SquaredLoss
 from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.ops import pallas_glm
 from photon_tpu.ops.pallas_glm import fused_data_value_and_grad
 from photon_tpu.optim.common import OptimizerConfig
 from photon_tpu.optim.lbfgs import minimize_lbfgs
@@ -32,12 +33,13 @@ def _problem(n, d, seed=0, poisson=False):
 @pytest.mark.parametrize(
     "loss,poisson", [(LogisticLoss, False), (PoissonLoss, True), (SquaredLoss, False)]
 )
-def test_fused_matches_autodiff(loss, poisson):
+def test_fused_matches_autodiff(loss, poisson, monkeypatch):
     n, d = 37, 13  # deliberately not tile/lane aligned
+    monkeypatch.setattr(pallas_glm, "DEFAULT_TILE_N", 8)  # multi-tile grid
     X, y, weight, offset, w = _problem(n, d, poisson=poisson)
     val, grad = fused_data_value_and_grad(
         loss, jnp.asarray(w), jnp.asarray(X), jnp.asarray(y),
-        jnp.asarray(offset), jnp.asarray(weight), tile_n=8,
+        jnp.asarray(offset), jnp.asarray(weight),
     )
     obj = GLMObjective(loss=loss)
     batch = LabeledBatch(jnp.asarray(y), jnp.asarray(X), jnp.asarray(offset), jnp.asarray(weight))
@@ -47,14 +49,17 @@ def test_fused_matches_autodiff(loss, poisson):
 
 
 @pytest.mark.parametrize("tile_n", [8, 64, 4096])
-def test_fused_tile_height_invariance(tile_n):
+def test_fused_tile_height_invariance(tile_n, monkeypatch):
     """Identical results at any tile height, including tile_n > n (the
-    n-cap clamps it) and the big default (grid-step amortization)."""
+    n-cap clamps it) and the big default (grid-step amortization). The
+    height is a module constant since the round-4 A/B deleted the per-call
+    override — geometry varies via monkeypatch only."""
+    monkeypatch.setattr(pallas_glm, "DEFAULT_TILE_N", tile_n)
     n, d = 200, 24
     X, y, weight, offset, w = _problem(n, d, seed=7)
     val, grad = fused_data_value_and_grad(
         LogisticLoss, jnp.asarray(w), jnp.asarray(X), jnp.asarray(y),
-        jnp.asarray(offset), jnp.asarray(weight), tile_n=tile_n,
+        jnp.asarray(offset), jnp.asarray(weight),
     )
     obj = GLMObjective(loss=LogisticLoss)
     batch = LabeledBatch(
@@ -65,10 +70,9 @@ def test_fused_tile_height_invariance(tile_n):
     np.testing.assert_allclose(np.asarray(grad), np.asarray(grad_ref), rtol=1e-4, atol=1e-5)
 
 
-def test_tile_geometry():
+def test_tile_geometry(monkeypatch):
     """The tall default must never cost real padding: tile height clamps
     to the data, rebalances across the grid, and respects the VMEM cap."""
-    from photon_tpu.ops import pallas_glm
     from photon_tpu.ops.pallas_glm import DEFAULT_TILE_N, _tile_geometry
 
     assert DEFAULT_TILE_N >= 4096  # the default really is tall
@@ -93,11 +97,12 @@ def test_tile_geometry():
             assert npad - (1 << 21) <= (npad // t) * sublane
 
     # Numerical parity at a rebalanced odd size spanning several tiles.
+    monkeypatch.setattr(pallas_glm, "DEFAULT_TILE_N", 512)
     n, d = 1030, 8
     X, y, weight, offset, w = _problem(n, d, seed=11)
     val, grad = fused_data_value_and_grad(
         LogisticLoss, jnp.asarray(w), jnp.asarray(X), jnp.asarray(y),
-        jnp.asarray(offset), jnp.asarray(weight), tile_n=512,
+        jnp.asarray(offset), jnp.asarray(weight),
     )
     obj = GLMObjective(loss=LogisticLoss)
     batch = LabeledBatch(
@@ -184,19 +189,32 @@ def test_fused_return_margins():
 
 
 @pytest.mark.parametrize("tile_n", [8, 64, 4096])
-def test_fused_hvp_matches_dense_hessian(tile_n):
+def test_fused_hvp_matches_dense_hessian(tile_n, monkeypatch):
     """fused_data_hvp == Xᵀ·diag(d2)·X·v at any tile height, non-aligned
     shapes included."""
     from photon_tpu.ops.pallas_glm import fused_data_hvp
 
+    monkeypatch.setattr(pallas_glm, "DEFAULT_TILE_N", tile_n)
     rng = np.random.default_rng(13)
     n, d = 211, 19
     X = rng.normal(size=(n, d)).astype(np.float32)
     v = rng.normal(size=d).astype(np.float32)
     d2 = rng.uniform(0.05, 1.0, size=n).astype(np.float32)
-    got = fused_data_hvp(jnp.asarray(v), jnp.asarray(X), jnp.asarray(d2), tile_n=tile_n)
+    got = fused_data_hvp(jnp.asarray(v), jnp.asarray(X), jnp.asarray(d2))
     ref = X.T @ (d2 * (X @ v))
     np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_losing_lowerings_deleted():
+    """The round-4 FE A/B left exactly ONE lowering: no per-call tile-height
+    override survives on either public entry point (the losing short-tile
+    variants were deleted, not gated)."""
+    import inspect
+
+    from photon_tpu.ops.pallas_glm import fused_data_hvp
+
+    for fn in (fused_data_value_and_grad, fused_data_hvp):
+        assert "tile_n" not in inspect.signature(fn).parameters
 
 
 def test_tpu_availability_gate_cpu_smoke(monkeypatch):
