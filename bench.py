@@ -3177,6 +3177,419 @@ def _soak_ref_request(body: bytes):
     return request_from_json(json.loads(body))
 
 
+def run_fleet_soak(
+    duration_s: float = 8.0,
+    replicas: int = 3,
+    E: int = 6144,
+    d_re: int = 4096,
+    d_fix: int = 8,
+    smoke: bool = False,
+    scale_bar: float = 2.2,
+):
+    """Scorer-fleet soak (ISSUE 13): N consistent-hash replicas over an
+    entity-sharded hot/cold store vs ONE replica holding the same
+    entity working set.
+
+    On this host the speedup is a CACHE property, not a parallelism one
+    (every process shares the same cores): the hot set is sized to ~N× a
+    single replica's ``hot_bytes`` budget, so the N=1 store thrashes its
+    LRU — every micro-batch pays host gathers plus a full functional
+    scatter copy of the hot table — while at N=%(replicas)s each replica's
+    DISJOINT ring shard fits entirely in budget and the miss path vanishes
+    after one warm sweep.
+
+    Acceptance: QPS(N) ≥ ``scale_bar``× QPS(1); zero caller errors across
+    the whole run INCLUDING a ``serve.replica_kill`` fault-plan SIGKILL
+    (shard fails over FE-only, then re-homes exactly on revive), a live
+    join, and a drain/leave; bit parity vs an in-process engine loaded
+    from the same model dir; per-replica hit/miss counters proving the
+    disjoint hot sets; and fleet-wide tenant sheds matching
+    single-process token-bucket semantics (ONE ledger charge per request
+    no matter the fleet size).
+    """
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.io.model_io import publish_latest_pointer, save_game_model
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.serve import AdmissionConfig, QuotaExceededError
+    from photon_tpu.serve import ServeConfig as _SC
+    from photon_tpu.serve.engine import load_engine as _load_engine
+    from photon_tpu.serve.fleet import FleetBackend, ScorerFleet
+    from photon_tpu.types import TaskType
+
+    if smoke:
+        E, d_re, d_fix = 384, 64, 8
+        duration_s = min(duration_s, 2.0)
+
+    rng = np.random.default_rng(43)
+    root = tempfile.mkdtemp(prefix="photon-fleet-")
+    imap_a = IndexMap.build([f"a{j}" for j in range(d_fix)])
+    imap_b = IndexMap.build([f"b{j}" for j in range(d_re)])
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"u{e}")
+    imap_a.save(os.path.join(root, "index-map-sa.json"))
+    imap_b.save(os.path.join(root, "index-map-sb.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    w_fix = rng.normal(size=d_fix).astype(np.float32)
+    w_re = (rng.normal(size=(E, d_re)) / 8).astype(np.float32)
+    model = GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(w_fix), TaskType.LOGISTIC_REGRESSION
+            ),
+            "sa",
+        ),
+        "per_user": RandomEffectModel(
+            w_re, "userId", "sb", TaskType.LOGISTIC_REGRESSION
+        ),
+    })
+    gen_dir = os.path.join(root, "gen-fleet")
+    save_game_model(
+        model, gen_dir, {"sa": imap_a, "sb": imap_b}, {"userId": eidx},
+        sparsity_threshold=0.0,
+    )
+    publish_latest_pointer(root, "gen-fleet")
+
+    # Per-replica budget: holds one ring shard (+35% vnode-variance slack)
+    # but only ~1/N of the full table — the N=1 phase MUST thrash.
+    budget_rows = int(E / replicas * 1.35)
+    hot_bytes = budget_rows * d_re * 4
+    nnz = 8  # sparse RE features per request: realistic and keeps JSON small
+    feat_idx = rng.integers(0, d_re, size=(256, nnz))
+    feat_val = rng.normal(size=(256, nnz)).astype(np.float32)
+
+    def req(i: int) -> dict:
+        k = i % 256
+        return {
+            "features": {
+                "sa": {f"a{j}": 0.25 for j in range(d_fix)},
+                "sb": {
+                    f"b{feat_idx[k, z]}": float(feat_val[k, z])
+                    for z in range(nnz)
+                },
+            },
+            "entityIds": {"userId": f"u{i % E}"},
+        }
+
+    lock = threading.Lock()
+
+    def make_fleet(workdir, admission=None, replica_env=None):
+        return ScorerFleet(
+            gen_dir, workdir, artifacts_dir=root, route_re_type="userId",
+            hot_bytes=hot_bytes, max_batch_size=32, max_delay_ms=2.0,
+            admission=admission, replica_env=replica_env,
+            # Concurrent replica loads of the full-soak model contend for
+            # one core; each can take minutes, so the default 300s is short.
+            connect_timeout_s=1200.0,
+        )
+
+    def drive(backend, stop_at, counters, seed=0, tenant="web", window=16):
+        i = 7919 * (seed + 1)  # disjoint per-thread request streams
+        while time.perf_counter() < stop_at:
+            futs = [
+                backend.submit(req(int(i + k)), tenant, "interactive")
+                for k in range(window)
+            ]
+            i += window
+            ok = err = 0
+            for f in futs:
+                try:
+                    f.result(timeout=120)
+                    ok += 1
+                except Exception as exc:  # noqa: BLE001 — counted, asserted
+                    err += 1
+                    counters.setdefault("errors", []).append(repr(exc)[:200])
+            with lock:
+                counters["ok"] = counters.get("ok", 0) + ok
+                counters["err"] = counters.get("err", 0) + err
+
+    def warm_sweep(backend):
+        # One pass over every entity: at N>1 this fills each replica's
+        # disjoint shard; at N=1 it cannot (capacity < E by construction).
+        for base in range(0, E, 64):
+            futs = [
+                backend.submit(req(base + k), "warm", "interactive")
+                for k in range(min(64, E - base))
+            ]
+            for f in futs:
+                f.result(timeout=120)
+
+    def store_counters(fleet):
+        # {replica: {"hits": x, "misses": y}} from the per-replica scrape.
+        out = {}
+        for rid, snap in fleet.router.replica_metrics().items():
+            c = {"hits": 0.0, "misses": 0.0}
+            for m in snap:
+                if m["metric"] == "serve_store_hits_total":
+                    c["hits"] += m["value"] or 0
+                elif m["metric"] == "serve_store_misses_total":
+                    c["misses"] += m["value"] or 0
+            out[rid] = c
+        return out
+
+    def measured_phase(fleet, n_threads=4):
+        backend = FleetBackend(fleet.router)
+        warm_sweep(backend)
+        before = store_counters(fleet)
+        counters: dict = {}
+        stop_at = time.perf_counter() + duration_s
+        threads = [
+            threading.Thread(
+                target=drive, args=(backend, stop_at, counters, k)
+            )
+            for k in range(n_threads)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        after = store_counters(fleet)
+        delta = {
+            rid: {
+                "hits": after[rid]["hits"] - before.get(rid, {}).get("hits", 0),
+                "misses": (
+                    after[rid]["misses"]
+                    - before.get(rid, {}).get("misses", 0)
+                ),
+            }
+            for rid in after
+        }
+        hit_rate = {
+            rid: round(
+                c["hits"] / max(c["hits"] + c["misses"], 1.0), 4
+            )
+            for rid, c in delta.items()
+        }
+        assert not counters.get("errors"), counters["errors"][:5]
+        return counters.get("ok", 0) / wall, counters.get("ok", 0), hit_rate
+
+    results: dict = {}
+
+    # --- phase 1: N=1 (same budget, full working set → LRU thrash) --------
+    if not smoke:
+        _progress("fleet soak: N=1 baseline (thrashing store)")
+        fleet1 = make_fleet(tempfile.mkdtemp(prefix="photon-fleet-n1-"))
+        try:
+            fleet1.start(["r0"])
+            qps1, ok1, hit1 = measured_phase(fleet1)
+        finally:
+            fleet1.shutdown()
+        results["qps_n1"] = round(qps1, 1)
+        results["hit_rate_n1"] = hit1
+        _progress(f"fleet soak: N=1 {qps1:.0f} qps, hit rates {hit1}")
+
+    # --- phase 2: N replicas with a fault-plan SIGKILL armed on r1 --------
+    kill_plan = json.dumps({
+        "rules": [{"site": "serve.replica_kill", "kind": "kill",
+                   "at": [int(6.0 / 0.25)]}],
+    })
+    admission = AdmissionConfig(
+        tenant_qps={"abuser": 50.0}, tenant_burst={"abuser": 50.0}
+    )
+    rids = [f"r{i}" for i in range(replicas)]
+    fleet = make_fleet(
+        tempfile.mkdtemp(prefix="photon-fleet-nN-"),
+        admission=admission,
+        replica_env={"r1": {"PHOTON_TPU_FAULT_PLAN": kill_plan}},
+    )
+    try:
+        _progress(f"fleet soak: starting {replicas} replicas")
+        fleet.start(rids)
+        backend = FleetBackend(fleet.router)
+
+        # Kill drill first (the fault plan fires ~6s of heartbeats after
+        # r1 comes up): keep traffic flowing through the SIGKILL, assert
+        # zero caller errors, then revive into the unchanged ring.
+        def drill_loop(counters, stop):
+            i = 1 << 20
+            while not stop[0]:
+                try:
+                    futs = [
+                        backend.submit(req(i + k), "web", "interactive")
+                        for k in range(8)
+                    ]
+                except Exception as exc:  # noqa: BLE001 — caller-visible
+                    with lock:
+                        counters.setdefault("errors", []).append(
+                            repr(exc)[:200]
+                        )
+                    continue
+                i += 8
+                for f in futs:
+                    try:
+                        f.result(timeout=120)
+                        with lock:
+                            counters["ok"] = counters.get("ok", 0) + 1
+                    except Exception as exc:  # noqa: BLE001
+                        with lock:
+                            counters.setdefault("errors", []).append(
+                                repr(exc)[:200]
+                            )
+
+        drill: dict = {}
+        stop_flag = [False]
+        dt = threading.Thread(target=drill_loop, args=(drill, stop_flag))
+        dt.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            fleet.reap()
+            if fleet.router.states().get("r1") == "dead":
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("fault-plan SIGKILL of r1 never landed")
+        _progress("fleet soak: r1 SIGKILLed by fault plan; failover window")
+        time.sleep(2.0)  # traffic across the dead member's shard (FE-only)
+        stop_flag[0] = True
+        dt.join()
+        assert not drill.get("errors"), drill["errors"][:5]
+        results["kill_drill_ok"] = drill.get("ok", 0)
+        fleet.replica_env.pop("r1", None)  # disarm before respawn
+        fleet.revive("r1")
+
+        # Scaled measurement: disjoint shards, each fully hot-resident.
+        _progress(f"fleet soak: N={replicas} measured phase")
+        qpsN, okN, hitN = measured_phase(fleet)
+        results["qps_nN"] = round(qpsN, 1)
+        results["hit_rate_nN"] = hitN
+        _progress(f"fleet soak: N={replicas} {qpsN:.0f} qps, "
+                  f"hit rates {hitN}")
+
+        # Disjoint ownership: per-replica owned counts partition E.
+        stats = fleet.router.replica_stats()
+        owned = {
+            rid: s["partition"]["re_types"]["userId"]["owned"]
+            for rid, s in stats.items()
+        }
+        assert sum(owned.values()) == E and all(
+            0 < v < E for v in owned.values()
+        ), owned
+        results["owned_entities"] = owned
+
+        # Elastic membership: join + drain/leave under live traffic.
+        drill2: dict = {}
+        stop2 = [False]
+        dt = threading.Thread(target=drill_loop, args=(drill2, stop2))
+        dt.start()
+        fleet.join(f"r{replicas}")
+        time.sleep(1.0)
+        fleet.leave(f"r{replicas}")
+        stop2[0] = True
+        dt.join()
+        assert not drill2.get("errors"), drill2["errors"][:5]
+        results["join_leave_ok"] = drill2.get("ok", 0)
+
+        # Fleet-global admission: flood the quota'd tenant from several
+        # threads; the ledger must charge ONE bucket — admitted stays at
+        # single-process burst+rate×t no matter how many replicas exist.
+        flood_s = 2.0
+        shed = [0]
+        admitted = [0]
+
+        def abuse_loop():
+            stop_at = time.perf_counter() + flood_s
+            i = 1 << 24
+            while time.perf_counter() < stop_at:
+                i += 1
+                try:
+                    f = backend.submit(req(i), "abuser", "interactive")
+                    f.result(timeout=120)
+                    with lock:
+                        admitted[0] += 1
+                except QuotaExceededError:
+                    with lock:
+                        shed[0] += 1
+
+        ats = [threading.Thread(target=abuse_loop) for _ in range(3)]
+        for t in ats:
+            t.start()
+        for t in ats:
+            t.join()
+        single_process_budget = 50.0 + 50.0 * flood_s
+        assert shed[0] > 0, "abuser never shed despite 50qps fleet quota"
+        assert admitted[0] <= 1.5 * single_process_budget, (
+            f"fleet admitted {admitted[0]} abuser requests; single-process "
+            f"semantics allow ~{single_process_budget:.0f} — budgets are "
+            f"being charged per replica, not once fleet-wide"
+        )
+        ledger_view = fleet.ledger.snapshot().get("abuser", {})
+        assert ledger_view.get("shed", 0) == shed[0], (ledger_view, shed[0])
+        results["abuser_admitted"] = admitted[0]
+        results["abuser_shed"] = shed[0]
+        results["single_process_budget"] = single_process_budget
+
+        # Parity probe: routed scores bit-identical to an in-process
+        # engine loaded from the same model dir (the batch path).
+        probe_n = 64
+        futs = [
+            backend.submit(req(i), "probe", "interactive")
+            for i in range(probe_n)
+        ]
+        fleet_scores = np.asarray(
+            [f.result(timeout=120)["score"] for f in futs], np.float32
+        )
+        ref = _load_engine(gen_dir, artifacts_dir=root,
+                           config=_SC(max_batch_size=32))
+        ref_scores = np.asarray(
+            [
+                ref.submit(_soak_ref_request(
+                    json.dumps(req(i)).encode()
+                )).result(timeout=120)
+                for i in range(probe_n)
+            ],
+            np.float32,
+        )
+        ref.close()
+        exact = int(np.sum(fleet_scores == ref_scores))
+        assert exact == probe_n, (
+            f"fleet-vs-batch parity: only {exact}/{probe_n} bit-identical"
+        )
+        results["bit_exact_probe"] = f"{exact}/{probe_n}"
+
+        snap = fleet.fleet_snapshot()
+        assert snap["states"] == {r: "live" for r in rids}, snap["states"]
+        assert set(snap["shardRanges"]) == set(rids)
+    finally:
+        fleet.shutdown()
+
+    if not smoke:
+        ratio = results["qps_nN"] / max(results["qps_n1"], 1e-9)
+        results["scale_ratio"] = round(ratio, 2)
+        assert ratio >= scale_bar, (
+            f"QPS(N={replicas}) = {results['qps_nN']} is only {ratio:.2f}× "
+            f"QPS(1) = {results['qps_n1']}; bar is {scale_bar}×"
+        )
+        # The mechanism, not just the outcome: N=1 missed constantly, N=N
+        # stopped missing once the disjoint shards warmed.
+        assert min(results["hit_rate_nN"].values()) >= 0.99, results
+        assert max(results["hit_rate_n1"].values()) <= 0.9, results
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "metric": "fleet_soak",
+        "unit": "qps_scale_ratio",
+        "value": results.get("scale_ratio"),
+        "replicas": replicas,
+        "entities": E,
+        "d_re": d_re,
+        "hot_rows_per_replica": budget_rows,
+        "smoke": smoke,
+        **results,
+    }
+
+
 def measure_cpu_baseline():
     """Same workload on CPU: scipy L-BFGS-B fixed effect + per-entity scipy
     solves, with identical data-pass accounting."""
@@ -3610,6 +4023,28 @@ def main():
         # <5% bytes per delta, shadow bit-parity, SIGKILL crash-resume
         # bit-equivalence; CPU-measurable.
         print(json.dumps(run_streaming_soak()))
+        return
+    if "--fleet-soak" in sys.argv:
+        # Consistent-hash scorer fleet vs one replica on the same hot-set
+        # budget: ≥2.2× QPS from disjoint-shard residency, zero caller
+        # errors across SIGKILL/join/leave, bit parity, fleet-global
+        # admission; CPU-measurable. --fleet-smoke runs the short CI
+        # drill (3 replicas, parity, kill+rejoin) without the scale bar.
+        def _fleet_opt(flag, default, cast):
+            if flag in sys.argv:
+                try:
+                    return cast(sys.argv[sys.argv.index(flag) + 1])
+                except (IndexError, ValueError):
+                    print(f"usage: bench.py --fleet-soak [{flag} <value>]",
+                          file=sys.stderr)
+                    sys.exit(2)
+            return default
+
+        print(json.dumps(run_fleet_soak(
+            duration_s=_fleet_opt("--soak-duration", 8.0, float),
+            replicas=_fleet_opt("--fleet-replicas", 3, int),
+            smoke="--fleet-smoke" in sys.argv,
+        )))
         return
     if "--serve-soak" in sys.argv:
         # Multi-process front end under sustained mixed-tenant load with

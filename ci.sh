@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|rollout|streaming|exhaustion|install|kernels|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|fleet|rollout|streaming|exhaustion|install|kernels|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -493,6 +493,20 @@ run_soak() {
     echo "   serve-soak smoke OK"
 }
 
+run_fleet() {
+    # Scorer-fleet smoke: 3 consistent-hash replicas over disjoint ring
+    # shards of the entity store, driven through the routing front end.
+    # run_fleet_soak --fleet-smoke asserts the ISSUE 13 drill: bit parity
+    # vs an in-process engine, a serve.replica_kill fault-plan SIGKILL
+    # surviving with zero caller errors (shard degrades FE-only, re-homes
+    # on revive), a live join + drain/leave, disjoint per-replica hit
+    # rates, and fleet-global admission charging ONE token bucket. The
+    # 2.2x QPS scaling bar runs in the full (non-smoke) soak only.
+    echo "== fleet: 3-replica parity + kill/rejoin + fleet admission =="
+    JAX_PLATFORMS=cpu python bench.py --fleet-soak --fleet-smoke
+    echo "   fleet-soak smoke OK"
+}
+
 run_rollout() {
     # Continuous-rollout smoke: the full generation lifecycle in one
     # process — train gen-1, serve it, incremental-retrain gen-2, shadow
@@ -600,12 +614,13 @@ case "$stage" in
     serve) run_serve ;;
     faults) run_faults ;;
     soak) run_soak ;;
+    fleet) run_fleet ;;
     rollout) run_rollout ;;
     streaming) run_streaming ;;
     exhaustion) run_exhaustion ;;
     install) run_install ;;
     kernels) run_kernels ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_rollout; run_streaming; run_exhaustion; run_kernels; run_unit ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_fleet; run_rollout; run_streaming; run_exhaustion; run_kernels; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

@@ -179,8 +179,27 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._instruments: Dict[Tuple[str, LabelKey], _Instrument] = {}
+        self._default_labels: Dict[str, str] = {}
+
+    def set_default_labels(self, **labels) -> None:
+        """Labels merged into every instrument created AFTER this call —
+        how a fleet replica stamps ``replica=<id>`` on all its serve
+        metrics without threading the id through every call site. Explicit
+        labels win on collision; passing nothing clears the defaults.
+        Set once at process start (before instruments exist): instruments
+        created earlier keep their original label sets."""
+        with self._lock:
+            self._default_labels = {
+                str(k): str(v) for k, v in labels.items()
+            }
+
+    def default_labels(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._default_labels)
 
     def _get(self, cls, name: str, labels: Dict[str, object]):
+        if self._default_labels:
+            labels = {**self._default_labels, **labels}
         key = (name, _label_key(labels))
         with self._lock:
             inst = self._instruments.get(key)
@@ -204,7 +223,11 @@ class MetricsRegistry:
         return self._get(Histogram, name, labels)
 
     def find(self, name: str, **labels) -> Optional[_Instrument]:
-        """Lookup without creating (tests, bench readers)."""
+        """Lookup without creating (tests, bench readers). Default labels
+        are merged the same way ``_get`` merges them, so an in-process
+        reader addresses instruments by the labels IT passed at creation."""
+        if self._default_labels:
+            labels = {**self._default_labels, **labels}
         with self._lock:
             return self._instruments.get((name, _label_key(labels)))
 
@@ -224,6 +247,7 @@ class MetricsRegistry:
     def reset(self) -> None:
         with self._lock:
             self._instruments.clear()
+            self._default_labels = {}
 
 
 _REGISTRY = MetricsRegistry()

@@ -1,11 +1,14 @@
 """Online GAME serving: micro-batched scoring, hot/cold entity residency,
-zero-downtime reload. See serve/engine.py for the composition."""
+zero-downtime reload, and the consistent-hash scorer fleet. See
+serve/engine.py for the single-process composition and serve/fleet.py for
+the multi-replica topology."""
 
 from photon_tpu.serve.admission import (
     BATCH,
     INTERACTIVE,
     AdmissionConfig,
     AdmissionController,
+    FleetAdmissionLedger,
     QuotaExceededError,
     TokenBucket,
     parse_tenant_rates,
@@ -17,12 +20,21 @@ from photon_tpu.serve.batcher import (
     ScoreRequest,
 )
 from photon_tpu.serve.engine import ServeConfig, ServingEngine, load_engine
+from photon_tpu.serve.fleet import (
+    FleetBackend,
+    FleetHTTPFrontend,
+    FleetRouter,
+    ReplicaScorerServer,
+    ScorerFleet,
+    partition_from_snapshot,
+)
 from photon_tpu.serve.frontend import (
     ScorerClient,
     ScorerServer,
     ServingFrontend,
 )
-from photon_tpu.serve.store import HotColdEntityStore
+from photon_tpu.serve.routing import HashRing, route_key, stable_hash
+from photon_tpu.serve.store import HotColdEntityStore, StorePartition
 
 __all__ = [
     "AdmissionConfig",
@@ -30,17 +42,28 @@ __all__ = [
     "BackpressureError",
     "BATCH",
     "DeadlineExceededError",
+    "FleetAdmissionLedger",
+    "FleetBackend",
+    "FleetHTTPFrontend",
+    "FleetRouter",
+    "HashRing",
     "HotColdEntityStore",
     "INTERACTIVE",
     "MicroBatcher",
     "QuotaExceededError",
+    "ReplicaScorerServer",
     "ScoreRequest",
     "ScorerClient",
+    "ScorerFleet",
     "ScorerServer",
     "ServeConfig",
     "ServingEngine",
     "ServingFrontend",
+    "StorePartition",
     "TokenBucket",
     "load_engine",
     "parse_tenant_rates",
+    "partition_from_snapshot",
+    "route_key",
+    "stable_hash",
 ]

@@ -230,3 +230,55 @@ class AdmissionController:
                     burst=bucket.burst if bucket is not None else None,
                 )
             return out
+
+
+class FleetAdmissionLedger(AdmissionController):
+    """Fleet-global admission: ONE token-bucket ledger for the whole scorer
+    fleet, living in the routing front end (single-coordinator model — the
+    frontend already sees every request, so the coordinator is free; no
+    gossip protocol to converge or partition).
+
+    Replica engines run with admission DISABLED (default unlimited config),
+    so a tenant's budget is charged exactly once fleet-wide — an abusive
+    tenant is shed identically whether the fleet has 1 replica or 50, which
+    is the ISSUE's "fleet-wide shed counts match single-process admission
+    semantics" bar.
+
+    On top of the inherited quota/priority machinery this ledger tracks
+    per-replica in-flight counts (begin/end around each routed request) —
+    the router's least-loaded tiebreak for entity-less requests and the
+    drain discipline's "replica is idle" signal.
+    """
+
+    def __init__(
+        self,
+        config: Optional[AdmissionConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        super().__init__(config=config, clock=clock)
+        self._inflight: Dict[str, int] = {}
+
+    def begin(self, replica_id: str) -> None:
+        with self._lock:
+            self._inflight[replica_id] = self._inflight.get(replica_id, 0) + 1
+
+    def end(self, replica_id: str) -> None:
+        with self._lock:
+            n = self._inflight.get(replica_id, 0) - 1
+            if n <= 0:
+                self._inflight.pop(replica_id, None)
+            else:
+                self._inflight[replica_id] = n
+
+    def inflight(self, replica_id: Optional[str] = None) -> int:
+        with self._lock:
+            if replica_id is not None:
+                return self._inflight.get(replica_id, 0)
+            return sum(self._inflight.values())
+
+    def fleet_snapshot(self) -> Dict:
+        """Tenant quota state + per-replica in-flight depth for the fleet
+        ``/healthz`` block."""
+        with self._lock:
+            inflight = dict(self._inflight)
+        return dict(tenants=self.snapshot(), inflight=inflight)

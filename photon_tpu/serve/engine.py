@@ -65,7 +65,7 @@ from photon_tpu.serve.admission import (
     AdmissionController,
 )
 from photon_tpu.serve.batcher import MicroBatcher, ScoreRequest
-from photon_tpu.serve.store import HotColdEntityStore
+from photon_tpu.serve.store import HotColdEntityStore, StorePartition
 from photon_tpu.utils import faults, resources
 
 logger = logging.getLogger("photon_tpu")
@@ -151,9 +151,13 @@ class ServingEngine:
         index_maps: Optional[Dict[str, IndexMap]] = None,
         config: Optional[ServeConfig] = None,
         model_version: str = "0",
+        partition: Optional[StorePartition] = None,
     ):
         self.config = config or ServeConfig()
         self.max_batch = bucket_dim(int(self.config.max_batch_size))
+        # Fleet shard ownership: every generation's store is built with the
+        # current partition; set_partition swaps the predicate live.
+        self._partition = partition
         self._entity_indexes = dict(entity_indexes or {})
         self._index_maps = dict(index_maps or {})
         self._shard_dims = model.feature_shard_dims()
@@ -225,6 +229,7 @@ class ServingEngine:
                 hot_bytes=self.config.hot_bytes,
                 # Floor: one batch's unique entities always fit resident.
                 min_hot_rows=self.max_batch,
+                partition=self._partition,
             )
             store.warm_uploads(self.max_batch)
             transformer = GameTransformer(store.scoring_model())
@@ -890,6 +895,22 @@ class ServingEngine:
             self.promote(out["model_version"])
         return out
 
+    def set_partition(self, partition: Optional[StorePartition]) -> Dict:
+        """Swap the fleet shard-ownership predicate live on EVERY resident
+        generation's store (ring rebalance / membership change). Rows the
+        new predicate disowns age out of the hot set; newly-owned rows
+        promote on their next request (or, for compacted hosts, after the
+        next reload rebuilds the host subset)."""
+        with self._lock:
+            self._partition = partition
+            for state in self._states.values():
+                state.store.set_partition(partition)
+            stats = self._state.store.partition_stats()
+        return dict(
+            partition=stats,
+            versions=sorted(self._states),
+        )
+
     def stats(self) -> Dict:
         state = self._state
         degraded = sorted(
@@ -910,6 +931,7 @@ class ServingEngine:
             trace_count=state.transformer.trace_count,
             retraces_since_warmup=self.retraces_since_warmup,
             store=state.store.stats(),
+            partition=state.store.partition_stats(),
             degraded=bool(degraded) or self._last_reload_error is not None,
             degraded_re_types=degraded,
             breaker_trips={
@@ -937,6 +959,7 @@ def load_engine(
     artifacts_dir: Optional[str] = None,
     config: Optional[ServeConfig] = None,
     model_version: Optional[str] = None,
+    partition: Optional[StorePartition] = None,
 ) -> ServingEngine:
     """Build an engine from a trained model directory the way the batch
     scoring driver would: index maps + entity indexes from the artifacts
@@ -985,4 +1008,5 @@ def load_engine(
         index_maps=index_maps,
         config=config,
         model_version=model_version or model_dir.rstrip("/"),
+        partition=partition,
     )

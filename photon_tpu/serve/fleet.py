@@ -1,0 +1,907 @@
+"""Scorer fleet: N entity-sharded scorer processes behind one router.
+
+Photon ML's premise (PAPER.md §2.9) is that no single machine holds the
+model; this module is the serving side of that claim. Topology:
+
+- **One routing front end** (this process): owns the :class:`HashRing`,
+  one framed-socket :class:`~photon_tpu.serve.frontend.ScorerClient` per
+  replica, and the :class:`~photon_tpu.serve.admission.FleetAdmissionLedger`
+  — the single coordinator for fleet-global tenant quotas (the frontend
+  already sees every request, so the coordinator is free; no gossip).
+- **N scorer replicas** (subprocesses, ``python -m photon_tpu.serve.fleet``):
+  each a full :class:`~photon_tpu.serve.engine.ServingEngine` whose
+  :class:`~photon_tpu.serve.store.StorePartition` claims only the entities
+  the ring assigns it. A replica's hot set is its DISJOINT ring shard —
+  cache hit rate is a routing property, not a budget property.
+
+Degradation, never errors: a request landing on a replica that does not
+own its entity (mis-route, membership churn, failover after a SIGKILL)
+resolves that entity cold → the random effect contributes 0 → FE-only
+score. The ``serve.replica_kill`` fault site (fired from the replica
+heartbeat, targeted per replica via ``PHOTON_TPU_FAULT_PLAN`` in the
+replica's environment) proves the full cycle: kill → router marks the
+member dead → its shard fails over along the ring's preference order to
+live successors (FE-only for the foreign entities) → revive → re-home to
+exact scores. Elastic membership reuses the rollout watcher's settle
+discipline: a leaving replica drains its in-flight work before the ring
+drops it and the fleet re-partitions.
+
+``bench.py --fleet-soak`` drives the whole story; ``./ci.sh fleet`` is
+the 3-replica smoke. The runbook lives in README.md ("Fleet serving
+runbook").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from http.server import ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+
+from photon_tpu.obs.metrics import registry
+from photon_tpu.serve.admission import (
+    INTERACTIVE,
+    AdmissionConfig,
+    FleetAdmissionLedger,
+)
+from photon_tpu.serve.batcher import BackpressureError
+from photon_tpu.serve.frontend import (
+    ScorerClient,
+    ScorerServer,
+    make_http_handler,
+)
+from photon_tpu.serve.routing import HashRing, route_key
+from photon_tpu.serve.store import StorePartition
+from photon_tpu.utils import faults
+
+logger = logging.getLogger("photon_tpu")
+
+# Router-side member states. DRAINING members finish in-flight work but
+# receive no new requests; DEAD members are skipped until revived.
+LIVE = "live"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+def partition_from_snapshot(
+    replica_id: str,
+    snapshot: dict,
+    route_re_type: Optional[str] = None,
+    compact_host: bool = True,
+) -> StorePartition:
+    """A replica's shard-ownership predicate from a ring snapshot. When a
+    routing RE type is named, ONLY that type shards — secondary types stay
+    fully replicated on every member, which is what makes a routed
+    request's score bit-identical to the batch driver's (the routed type
+    is hot-or-cold exactly as a single process would have it; every other
+    type is simply there)."""
+    return StorePartition(
+        replica_id=str(replica_id),
+        ring=HashRing.from_snapshot(snapshot),
+        re_types=(route_re_type,) if route_re_type else None,
+        compact_host=compact_host,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Replica side
+# ---------------------------------------------------------------------------
+
+
+class ReplicaScorerServer(ScorerServer):
+    """The per-replica IPC server: everything ``ScorerServer`` speaks
+    (score/stats/reload/feedback/ping) plus the fleet control plane —
+    ``ring`` installs a new membership snapshot live (the elastic-join
+    rebalance path) and ``replica_info`` answers the router's probes."""
+
+    def __init__(
+        self,
+        engine,
+        socket_path: str,
+        replica_id: str,
+        route_re_type: Optional[str] = None,
+        compact_host: bool = True,
+    ):
+        super().__init__(engine, socket_path)
+        self.replica_id = str(replica_id)
+        self.route_re_type = route_re_type
+        self.compact_host = compact_host
+        self.ring_version: Optional[int] = None
+
+    def _dispatch(self, msg: dict, out) -> None:
+        rid = msg.get("id")
+        op = msg.get("op")
+        if op == "ring":
+            try:
+                snap = msg.get("snapshot") or {}
+                partition = partition_from_snapshot(
+                    self.replica_id,
+                    snap,
+                    msg.get("routeReType", self.route_re_type),
+                    compact_host=self.compact_host,
+                )
+                info = self.engine.set_partition(partition)
+                self.ring_version = partition.ring.version
+                logger.info(
+                    "fleet replica %s: installed ring v%s (%d members)",
+                    self.replica_id, partition.ring.version,
+                    len(partition.ring),
+                )
+                out.put(dict(id=rid, ok=True, result=info))
+            except Exception as exc:  # noqa: BLE001 — per-request failure
+                out.put(self._error_payload(rid, exc))
+            return
+        if op == "replica_info":
+            try:
+                out.put(dict(id=rid, ok=True, result=dict(
+                    replica=self.replica_id,
+                    pid=os.getpid(),
+                    ringVersion=self.ring_version,
+                    partition=self.engine.stats().get("partition"),
+                )))
+            except Exception as exc:  # noqa: BLE001 — per-request failure
+                out.put(self._error_payload(rid, exc))
+            return
+        if op == "metrics":
+            # Per-replica counter/gauge scrape (every instrument carries the
+            # ``replica`` default label): how the fleet soak proves disjoint
+            # hot sets from hit/miss rates without an HTTP port per replica.
+            try:
+                from photon_tpu.obs.metrics import registry
+
+                out.put(dict(id=rid, ok=True, result=registry().snapshot()))
+            except Exception as exc:  # noqa: BLE001 — per-request failure
+                out.put(self._error_payload(rid, exc))
+            return
+        super()._dispatch(msg, out)
+
+
+def _replica_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon-tpu-fleet-replica",
+        description="One scorer-fleet replica: a ServingEngine owning the "
+        "ring shard of its --replica-id, served over a framed Unix socket.",
+    )
+    p.add_argument("--socket", required=True)
+    p.add_argument("--replica-id", required=True)
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--artifacts-dir", default=None)
+    p.add_argument("--ring", required=True,
+                   help="ring snapshot JSON (members/vnodes/seed/version)")
+    p.add_argument("--route-re-type", default=None,
+                   help="RE type the fleet shards; others stay replicated")
+    p.add_argument("--no-compact-host", action="store_true",
+                   help="keep the full host master per replica (re-homing "
+                   "without reload, at full host memory per member)")
+    p.add_argument("--hot-bytes", type=int, default=64 << 20)
+    p.add_argument("--max-batch-size", type=int, default=64)
+    p.add_argument("--max-delay-ms", type=float, default=2.0)
+    p.add_argument("--queue-cap", type=int, default=1024)
+    p.add_argument("--spool-dir", default=None,
+                   help="BASE feedback spool dir; this replica spools into "
+                   "<base>/<replica-id> (the updater polls the glob)")
+    p.add_argument("--feedback-join-ttl", type=float, default=300.0)
+    p.add_argument("--heartbeat-s", type=float, default=0.25,
+                   help="fault-site heartbeat period (serve.replica_kill)")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def replica_main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _replica_argparser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format=f"%(asctime)s {args.replica_id} %(levelname)s %(message)s",
+    )
+    # Before ANY instrument exists: every serve metric this process emits
+    # carries replica=<id>, so a merged fleet report stays attributable.
+    registry().set_default_labels(replica=args.replica_id)
+
+    snap = json.loads(args.ring)
+    partition = partition_from_snapshot(
+        args.replica_id, snap, args.route_re_type,
+        compact_host=not args.no_compact_host,
+    )
+
+    from photon_tpu.serve.engine import ServeConfig, load_engine
+
+    config = ServeConfig(
+        max_batch_size=args.max_batch_size,
+        max_delay_ms=args.max_delay_ms,
+        queue_cap=args.queue_cap,
+        hot_bytes=args.hot_bytes,
+    )
+    engine = load_engine(
+        args.model_dir, args.artifacts_dir, config, partition=partition
+    )
+
+    if args.spool_dir:
+        from photon_tpu.stream.spool import FeedbackSpool, SpoolConfig
+
+        spool_dir = os.path.join(args.spool_dir, args.replica_id)
+        spool = FeedbackSpool(
+            spool_dir, SpoolConfig(join_ttl_s=args.feedback_join_ttl)
+        )
+        spool.start_auto_flush()
+        engine.attach_feedback(spool)
+        logger.info("fleet replica %s: spool at %s",
+                    args.replica_id, spool_dir)
+
+    server = ReplicaScorerServer(
+        engine, args.socket, args.replica_id, args.route_re_type,
+        compact_host=not args.no_compact_host,
+    )
+    server.ring_version = partition.ring.version
+    server.start()
+
+    stop = threading.Event()
+
+    def _term(signum, frame):  # noqa: ARG001 — signal signature
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    # Machine-readable ready banner (the controller logs it; liveness is
+    # established by the router's retry-connect, not by parsing this).
+    print(json.dumps(dict(
+        event="ready", replica=args.replica_id, pid=os.getpid(),
+        socket=args.socket, ringVersion=partition.ring.version,
+        partition=engine.stats().get("partition"),
+    )), flush=True)
+
+    # Heartbeat: the serve.replica_kill fault site lives HERE, on the main
+    # thread, so a plan rule (targeted per replica via the label) SIGKILLs
+    # the whole process mid-traffic — the crash the failover drill needs.
+    while not stop.is_set():
+        faults.check("serve.replica_kill", label=args.replica_id)
+        stop.wait(args.heartbeat_s)
+
+    # SIGTERM drain: stop accepting, let in-flight batches finish.
+    logger.info("fleet replica %s: draining", args.replica_id)
+    server.close()
+    engine.close(drain=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Router side (the front-end process)
+# ---------------------------------------------------------------------------
+
+
+class FleetRouter:
+    """Consistent-hash request routing over the replica set.
+
+    Every request routes by its entity key's ring owner; a dead owner's
+    traffic walks the ring's preference order to the first live member
+    (which scores the foreign entities FE-only — degraded, never an
+    error). Entity-less requests go to the least-loaded live member.
+    A lost connection mid-flight retries the request on the next live
+    candidate, so a SIGKILL'd replica costs zero caller errors.
+    """
+
+    UID_OWNER_CAP = 1 << 18  # uid → replica memory bound (feedback routing)
+
+    def __init__(
+        self,
+        ring: HashRing,
+        ledger: FleetAdmissionLedger,
+        route_re_type: Optional[str] = None,
+        queue_cap: int = 1024,
+        result_timeout_s: float = 120.0,
+    ):
+        self.ring = ring
+        self.ledger = ledger
+        self.route_re_type = route_re_type
+        self.queue_cap = int(queue_cap)
+        self.result_timeout_s = result_timeout_s
+        self._lock = threading.RLock()
+        self._clients: Dict[str, ScorerClient] = {}
+        self._state: Dict[str, str] = {}
+        self._uid_owner: "OrderedDict[str, str]" = OrderedDict()
+
+    # -- membership ---------------------------------------------------------
+
+    def attach(
+        self, replica_id: str, socket_path: str,
+        connect_timeout_s: float = 180.0,
+    ) -> ScorerClient:
+        """Connect (retrying while the replica warms) and mark live."""
+        client = ScorerClient(socket_path, connect_timeout_s)
+        with self._lock:
+            old = self._clients.get(replica_id)
+            self._clients[replica_id] = client
+            self._state[replica_id] = LIVE
+        if old is not None:
+            old.close()
+        return client
+
+    def mark(self, replica_id: str, state: str) -> None:
+        with self._lock:
+            self._state[replica_id] = state
+
+    def detach(self, replica_id: str) -> None:
+        with self._lock:
+            client = self._clients.pop(replica_id, None)
+            self._state.pop(replica_id, None)
+        if client is not None:
+            client.close()
+
+    def client(self, replica_id: str) -> Optional[ScorerClient]:
+        with self._lock:
+            return self._clients.get(replica_id)
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._state)
+
+    def live_members(self) -> List[str]:
+        with self._lock:
+            return [
+                m for m in self.ring.members
+                if self._state.get(m) == LIVE and m in self._clients
+            ]
+
+    def _on_conn_lost(self, replica_id: str) -> None:
+        with self._lock:
+            if self._state.get(replica_id) == LIVE:
+                self._state[replica_id] = DEAD
+                logger.warning(
+                    "fleet: replica %s connection lost; marked dead "
+                    "(shard fails over FE-only)", replica_id,
+                )
+
+    # -- scoring ------------------------------------------------------------
+
+    def _candidates(self, key: Optional[str]) -> List[str]:
+        if key is not None:
+            pref = self.ring.preference(key)
+            with self._lock:
+                return [
+                    m for m in pref
+                    if self._state.get(m) == LIVE and m in self._clients
+                ]
+        live = self.live_members()
+        # Entity-less requests are FE-only everywhere: least-loaded wins.
+        return sorted(live, key=lambda m: self.ledger.inflight(m))
+
+    def submit(
+        self,
+        raw_request: dict,
+        tenant: Optional[str],
+        priority: str = INTERACTIVE,
+        model_version: Optional[str] = None,
+    ) -> Future:
+        # Fleet-global admission: ONE ledger charge per request, before any
+        # replica sees it — identical shed semantics at any fleet size.
+        self.ledger.admit(
+            tenant, priority,
+            queue_depth=self.ledger.inflight(),
+            queue_cap=self.queue_cap,
+        )
+        entity_ids = (
+            raw_request.get("entityIds")
+            if isinstance(raw_request, dict) else None
+        )
+        key = route_key(entity_ids, self.route_re_type)
+        cands = self._candidates(key)
+        if not cands:
+            raise BackpressureError("no live scorer replicas")
+        dst: Future = Future()
+        self._try(raw_request, tenant, priority, model_version, cands, dst)
+        return dst
+
+    def _try(
+        self, raw_request, tenant, priority, model_version,
+        cands: List[str], dst: Future,
+    ) -> None:
+        replica_id, rest = cands[0], cands[1:]
+        client = self.client(replica_id)
+        if client is None:
+            self._advance(
+                raw_request, tenant, priority, model_version,
+                replica_id, rest, dst,
+                ConnectionError(f"replica {replica_id} not attached"),
+            )
+            return
+        registry().counter("fleet_requests_total", replica=replica_id).inc()
+        self.ledger.begin(replica_id)
+        try:
+            src = client.submit_score(
+                raw_request, tenant, priority, model_version
+            )
+        except ConnectionError as exc:
+            self.ledger.end(replica_id)
+            self._on_conn_lost(replica_id)
+            self._advance(
+                raw_request, tenant, priority, model_version,
+                replica_id, rest, dst, exc,
+            )
+            return
+
+        def _done(f: Future) -> None:
+            self.ledger.end(replica_id)
+            exc = f.exception()
+            if isinstance(exc, ConnectionError):
+                # The replica died with this request in flight. Scoring is
+                # read-only → safe to replay on the next live candidate.
+                self._on_conn_lost(replica_id)
+                self._advance(
+                    raw_request, tenant, priority, model_version,
+                    replica_id, rest, dst, exc,
+                )
+            elif exc is not None:
+                dst.set_exception(exc)
+            else:
+                res = dict(f.result() or {})
+                res["replica"] = replica_id
+                uid = (
+                    raw_request.get("uid")
+                    if isinstance(raw_request, dict) else None
+                )
+                if uid is not None:
+                    self._record_uid(str(uid), replica_id)
+                dst.set_result(res)
+
+        src.add_done_callback(_done)
+
+    def _advance(
+        self, raw_request, tenant, priority, model_version,
+        failed_id: str, rest: List[str], dst: Future,
+        exc: BaseException,
+    ) -> None:
+        registry().counter("fleet_failover_total", replica=failed_id).inc()
+        with self._lock:
+            nxt = [
+                m for m in rest
+                if self._state.get(m) == LIVE and m in self._clients
+            ]
+        if nxt:
+            self._try(raw_request, tenant, priority, model_version, nxt, dst)
+        else:
+            dst.set_exception(exc)
+
+    def _record_uid(self, uid: str, replica_id: str) -> None:
+        with self._lock:
+            self._uid_owner[uid] = replica_id
+            self._uid_owner.move_to_end(uid)
+            while len(self._uid_owner) > self.UID_OWNER_CAP:
+                self._uid_owner.popitem(last=False)
+
+    def uid_owner(self, uid: str) -> Optional[str]:
+        with self._lock:
+            return self._uid_owner.get(uid)
+
+    # -- control plane ------------------------------------------------------
+
+    def broadcast_ring(self, timeout_s: float = 120.0) -> Dict[str, dict]:
+        """Push the current ring snapshot to every live replica (each
+        rebuilds its partition predicate in place). Returns per-replica
+        results; a member failing the push is marked dead."""
+        snap = self.ring.snapshot()
+        out: Dict[str, dict] = {}
+        for replica_id in self.live_members():
+            client = self.client(replica_id)
+            if client is None:
+                continue
+            try:
+                out[replica_id] = client.call(
+                    "ring", timeout_s=timeout_s,
+                    snapshot=snap, routeReType=self.route_re_type,
+                )
+            except Exception as exc:  # noqa: BLE001 — per-member failure
+                logger.warning(
+                    "fleet: ring push to %s failed: %s", replica_id, exc
+                )
+                self._on_conn_lost(replica_id)
+                out[replica_id] = dict(error=str(exc))
+        return out
+
+    def replica_stats(self, timeout_s: float = 30.0) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for replica_id in self.live_members():
+            client = self.client(replica_id)
+            if client is None:
+                continue
+            try:
+                out[replica_id] = client.call("stats", timeout_s=timeout_s)
+            except Exception as exc:  # noqa: BLE001 — per-member failure
+                out[replica_id] = dict(error=str(exc))
+        return out
+
+    def replica_metrics(self, timeout_s: float = 30.0) -> Dict[str, list]:
+        """Per-replica metrics scrape: each live member's full
+        counter/gauge snapshot (labelled ``replica=<id>``)."""
+        out: Dict[str, list] = {}
+        for replica_id in self.live_members():
+            client = self.client(replica_id)
+            if client is None:
+                continue
+            try:
+                out[replica_id] = client.call("metrics", timeout_s=timeout_s)
+            except Exception:  # noqa: BLE001 — per-member failure
+                out[replica_id] = []
+        return out
+
+    def fleet_snapshot(self) -> dict:
+        """The ``/healthz`` ``fleet`` block: ring version, per-replica
+        shard ranges, member states, and the global admission ledger."""
+        return dict(
+            ringVersion=self.ring.version,
+            members=self.ring.members,
+            states=self.states(),
+            routeReType=self.route_re_type,
+            shardRanges=self.ring.shard_ranges(),
+            admission=self.ledger.fleet_snapshot(),
+        )
+
+
+class FleetBackend:
+    """The ``make_http_handler`` backend for the fleet front end: submits
+    route through the ring, ``/healthz`` carries the fleet snapshot,
+    reloads broadcast, and feedback follows each uid back to the replica
+    that scored it (so the label joins in the RIGHT per-replica spool)."""
+
+    def __init__(self, router: FleetRouter, result_timeout_s: float = 120.0):
+        self.router = router
+        self.result_timeout_s = result_timeout_s
+
+    def submit(
+        self, raw_request: dict, tenant: Optional[str], priority: str,
+        model_version: Optional[str] = None,
+    ) -> Future:
+        return self.router.submit(raw_request, tenant, priority, model_version)
+
+    def stats(self) -> dict:
+        return dict(
+            fleet=self.router.fleet_snapshot(),
+            replicas=self.router.replica_stats(),
+        )
+
+    def reload(self, body: dict) -> dict:
+        out: Dict[str, dict] = {}
+        for replica_id in self.router.live_members():
+            client = self.router.client(replica_id)
+            if client is None:
+                continue
+            out[replica_id] = client.call(
+                "reload", timeout_s=600.0,
+                modelDir=body.get("modelDir"),
+                modelVersion=body.get("modelVersion"),
+            )
+        return out
+
+    def feedback(self, body: dict) -> dict:
+        if not isinstance(body, dict):
+            raise ValueError("feedback body must be a JSON object")
+        items = body.get("labels")
+        if items is None:
+            items = [body]
+        if not isinstance(items, list):
+            raise ValueError("'labels' must be a list of {uid, label} objects")
+        # Group by the replica that scored each uid; unknown uids (aged out
+        # of the router's map, or scored before a restart) broadcast.
+        grouped: Dict[Optional[str], List[dict]] = {}
+        for item in items:
+            uid = item.get("uid") if isinstance(item, dict) else None
+            owner = self.router.uid_owner(str(uid)) if uid is not None else None
+            grouped.setdefault(owner, []).append(item)
+        joined = 0
+        dropped = 0
+        for owner, chunk in grouped.items():
+            targets = (
+                [owner] if owner in self.router.live_members()
+                else self.router.live_members()
+            )
+            chunk_joined = 0
+            for replica_id in targets:
+                client = self.router.client(replica_id)
+                if client is None:
+                    continue
+                try:
+                    res = client.call(
+                        "feedback", timeout_s=30.0, body={"labels": chunk}
+                    )
+                except Exception as exc:  # noqa: BLE001 — per-member failure
+                    logger.warning(
+                        "fleet: feedback to %s failed: %s", replica_id, exc
+                    )
+                    continue
+                chunk_joined += int(res.get("joined", 0))
+                if chunk_joined >= len(chunk):
+                    break  # broadcast resolved every uid already
+            joined += chunk_joined
+            dropped += max(0, len(chunk) - chunk_joined)
+        return {"joined": joined, "dropped": dropped}
+
+
+class FleetHTTPFrontend:
+    """ThreadingHTTPServer speaking the standard serving API over a
+    :class:`FleetBackend`, on a background thread. ``port`` is resolved
+    after ``start`` (pass 0 to let the OS pick)."""
+
+    def __init__(self, backend: FleetBackend, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.backend = backend
+        self._httpd = ThreadingHTTPServer(
+            (host, port), make_http_handler(backend)
+        )
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "FleetHTTPFrontend":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs=dict(poll_interval=0.1),
+            name="fleet-http", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        # shutdown() blocks forever unless serve_forever is running; a
+        # frontend that was never start()ed still needs its socket closed.
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# Fleet controller (spawn / join / drain / kill / revive)
+# ---------------------------------------------------------------------------
+
+
+class ScorerFleet:
+    """Owns the replica subprocesses and the elastic-membership protocol.
+
+    Lifecycle verbs: ``start`` (spawn + connect the initial set), ``join``
+    (spawn with the post-join ring, wait ready, THEN flip routing — the
+    warming replica never sees traffic early), ``leave`` (drain in-flight
+    via the settle discipline, drop from the ring, broadcast, SIGTERM),
+    ``kill`` (SIGKILL, ring UNCHANGED — the shard fails over FE-only along
+    the preference order), ``revive`` (respawn the same id, reconnect,
+    traffic re-homes to exact scores), ``shutdown``.
+    """
+
+    def __init__(
+        self,
+        model_dir: str,
+        workdir: str,
+        artifacts_dir: Optional[str] = None,
+        route_re_type: Optional[str] = None,
+        vnodes: int = 64,
+        seed: int = 0,
+        hot_bytes: int = 64 << 20,
+        max_batch_size: int = 64,
+        max_delay_ms: float = 2.0,
+        queue_cap: int = 1024,
+        admission: Optional[AdmissionConfig] = None,
+        spool_base: Optional[str] = None,
+        compact_host: bool = True,
+        result_timeout_s: float = 120.0,
+        connect_timeout_s: float = 300.0,
+        heartbeat_s: float = 0.25,
+        replica_env: Optional[Dict[str, Dict[str, str]]] = None,
+    ):
+        self.model_dir = model_dir
+        self.artifacts_dir = artifacts_dir
+        self.workdir = workdir
+        self.route_re_type = route_re_type
+        self.hot_bytes = int(hot_bytes)
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_ms = float(max_delay_ms)
+        self.queue_cap = int(queue_cap)
+        self.spool_base = spool_base
+        self.compact_host = compact_host
+        self.connect_timeout_s = connect_timeout_s
+        self.heartbeat_s = float(heartbeat_s)
+        # Per-replica extra environment — how a drill targets ONE replica
+        # with a PHOTON_TPU_FAULT_PLAN kill rule.
+        self.replica_env = dict(replica_env or {})
+        os.makedirs(workdir, exist_ok=True)
+        self.ring = HashRing(vnodes=vnodes, seed=seed)
+        self.ledger = FleetAdmissionLedger(admission)
+        self.router = FleetRouter(
+            self.ring, self.ledger, route_re_type,
+            queue_cap=queue_cap, result_timeout_s=result_timeout_s,
+        )
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._logs: Dict[str, object] = {}
+
+    # -- plumbing -----------------------------------------------------------
+
+    def socket_path(self, replica_id: str) -> str:
+        return os.path.join(self.workdir, f"scorer-{replica_id}.sock")
+
+    def log_path(self, replica_id: str) -> str:
+        return os.path.join(self.workdir, f"scorer-{replica_id}.log")
+
+    def _spawn(self, replica_id: str, ring_snapshot: dict) -> subprocess.Popen:
+        cmd = [
+            sys.executable, "-m", "photon_tpu.serve.fleet",
+            "--socket", self.socket_path(replica_id),
+            "--replica-id", replica_id,
+            "--model-dir", self.model_dir,
+            "--ring", json.dumps(ring_snapshot),
+            "--hot-bytes", str(self.hot_bytes),
+            "--max-batch-size", str(self.max_batch_size),
+            "--max-delay-ms", str(self.max_delay_ms),
+            "--queue-cap", str(self.queue_cap),
+            "--heartbeat-s", str(self.heartbeat_s),
+        ]
+        if self.artifacts_dir:
+            cmd += ["--artifacts-dir", self.artifacts_dir]
+        if self.route_re_type:
+            cmd += ["--route-re-type", self.route_re_type]
+        if self.spool_base:
+            cmd += ["--spool-dir", self.spool_base]
+        if not self.compact_host:
+            cmd += ["--no-compact-host"]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # The replica must import photon_tpu no matter the caller's cwd:
+        # put the package's parent dir on its path explicitly.
+        import photon_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(photon_tpu.__file__))
+        parts = [pkg_root] + [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
+        ]
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        env.update(self.replica_env.get(replica_id, {}))
+        log = open(self.log_path(replica_id), "ab")
+        old_log = self._logs.pop(replica_id, None)
+        if old_log is not None:
+            try:
+                old_log.close()
+            except OSError:
+                pass
+        self._logs[replica_id] = log
+        proc = subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT, env=env
+        )
+        self._procs[replica_id] = proc
+        logger.info(
+            "fleet: spawned replica %s (pid %d)", replica_id, proc.pid
+        )
+        return proc
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, replica_ids: Sequence[str]) -> "ScorerFleet":
+        for replica_id in replica_ids:
+            self.ring.add(replica_id)
+        snap = self.ring.snapshot()
+        for replica_id in replica_ids:
+            self._spawn(replica_id, snap)
+        for replica_id in replica_ids:
+            self.router.attach(
+                replica_id, self.socket_path(replica_id),
+                self.connect_timeout_s,
+            )
+        return self
+
+    def join(self, replica_id: str) -> None:
+        """Elastic join: the newcomer warms with the POST-join ring (its
+        partition is right from birth), traffic flips only once it is
+        connectable, then the incumbents re-partition. During the gap,
+        keys the new ring reassigns score FE-only on their old owner —
+        degraded, never failed."""
+        future_ring = HashRing(
+            members=self.ring.members + [replica_id],
+            vnodes=self.ring.vnodes, seed=self.ring.seed,
+            version=self.ring.version + 1,
+        )
+        self._spawn(replica_id, future_ring.snapshot())
+        self.router.attach(
+            replica_id, self.socket_path(replica_id), self.connect_timeout_s
+        )
+        self.ring.add(replica_id)  # same version the newcomer already holds
+        self.router.broadcast_ring()
+        logger.info("fleet: %s joined (ring v%d)", replica_id,
+                    self.ring.version)
+
+    def leave(self, replica_id: str, settle_s: float = 30.0) -> None:
+        """Graceful leave, same settle discipline as the rollout watcher:
+        stop routing new work to the member, wait for its in-flight count
+        to drain (bounded by ``settle_s``), re-partition the survivors,
+        then SIGTERM (the replica's own drain finishes anything left)."""
+        self.router.mark(replica_id, DRAINING)
+        deadline = time.monotonic() + settle_s
+        while (
+            self.ledger.inflight(replica_id) > 0
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        if replica_id in self.ring:
+            self.ring.remove(replica_id)
+        self.router.broadcast_ring()
+        self.router.detach(replica_id)
+        proc = self._procs.pop(replica_id, None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        logger.info("fleet: %s left (ring v%d)", replica_id,
+                    self.ring.version)
+
+    def kill(self, replica_id: str) -> None:
+        """SIGKILL a replica, ring unchanged — the crash drill. Its shard
+        fails over FE-only to ring successors until ``revive``."""
+        proc = self._procs.pop(replica_id, None)
+        if proc is not None:
+            proc.kill()
+            proc.wait()
+        self.router.mark(replica_id, DEAD)
+        logger.info("fleet: %s SIGKILLed (shard failing over FE-only)",
+                    replica_id)
+
+    def revive(self, replica_id: str) -> None:
+        """Bring a dead member back under the same id: respawn with the
+        CURRENT ring, reconnect, mark live — its keys re-home from
+        FE-only fallback to exact scores with zero ring movement."""
+        self._spawn(replica_id, self.ring.snapshot())
+        self.router.attach(
+            replica_id, self.socket_path(replica_id), self.connect_timeout_s
+        )
+        logger.info("fleet: %s revived", replica_id)
+
+    def reap(self) -> Dict[str, int]:
+        """Collect exit codes of replicas that died on their own (the
+        fault-plan kill path); marks them dead for the router."""
+        out: Dict[str, int] = {}
+        for replica_id, proc in list(self._procs.items()):
+            code = proc.poll()
+            if code is not None:
+                out[replica_id] = code
+                self._procs.pop(replica_id, None)
+                self.router.mark(replica_id, DEAD)
+        return out
+
+    def fleet_snapshot(self) -> dict:
+        snap = self.router.fleet_snapshot()
+        snap["pids"] = {
+            rid: proc.pid for rid, proc in self._procs.items()
+        }
+        return snap
+
+    def shutdown(self, timeout_s: float = 30.0) -> None:
+        for replica_id in list(self.router.states()):
+            self.router.detach(replica_id)
+        for replica_id, proc in list(self._procs.items()):
+            proc.terminate()
+        deadline = time.monotonic() + timeout_s
+        for replica_id, proc in list(self._procs.items()):
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        self._procs.clear()
+        for log in self._logs.values():
+            try:
+                log.close()
+            except OSError:
+                pass
+        self._logs.clear()
+
+
+if __name__ == "__main__":
+    sys.exit(replica_main())

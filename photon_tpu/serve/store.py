@@ -56,11 +56,63 @@ from photon_tpu.models.game import (
 )
 from photon_tpu.models.glm import GeneralizedLinearModel
 from photon_tpu.obs.metrics import registry
+from photon_tpu.serve.routing import HashRing
 from photon_tpu.utils import faults, resources
 
 logger = logging.getLogger("photon_tpu")
 
 _scatter_rows = None
+
+
+@dataclasses.dataclass
+class StorePartition:
+    """Entity-shard ownership for ONE fleet replica: this store serves only
+    the entities the consistent-hash ring assigns ``replica_id`` (the same
+    ring the front-end router uses, so a correctly routed request always
+    lands on the owner). A non-owned (foreign) entity resolves to -1 —
+    cold-start semantics, the random effect contributes 0 and the request
+    scores FE-only on already-compiled shapes. That is the fleet's
+    cross-shard fallback: mis-routed and orphaned entities degrade, never
+    error.
+
+    ``compact_host=True`` additionally shards the OOC host master by the
+    same hash (``algorithm/re_store.py``-style keying, at entity-row
+    granularity): only owned rows are kept host-side, so N replicas hold
+    ~1/N of the coefficient bytes each. The trade: an entity that becomes
+    owned AFTER build (ring rebalance toward this replica) has no host row
+    and stays FE-only until the engine rebuilds the store (reload) — the
+    documented re-home procedure.
+
+    ``re_types=None`` shards every budget-managed type; the fleet normally
+    passes just the routing RE type so secondary types stay fully
+    replicated (exact scores on every replica). Pinned types (full device
+    residency — they fit the budget) are never sharded: replicating a
+    small table is cheaper than degrading its lookups."""
+
+    replica_id: str
+    ring: HashRing
+    re_types: Optional[tuple] = None
+    compact_host: bool = True
+
+    def applies_to(self, re_type: str) -> bool:
+        return self.re_types is None or re_type in self.re_types
+
+    def owns(self, key) -> bool:
+        return self.ring.owner(str(key)) == self.replica_id
+
+
+def _owned_mask(
+    partition: StorePartition, entity_index, num_entities: int
+) -> np.ndarray:
+    """(E,) bool: which dense entity indices this replica owns. Hashes the
+    SAME string the router hashes — the raw entity id via the entity index
+    when one exists, else the decimal index (callers that send pre-interned
+    int keys route on that same decimal form)."""
+    owned = np.zeros(num_entities, bool)
+    for i in range(num_entities):
+        key = entity_index.entity_id(i) if entity_index is not None else i
+        owned[i] = partition.owns(key)
+    return owned
 
 
 def _oom_contained(re_type: str, fn):
@@ -129,6 +181,11 @@ class _ReGroup:
     pinned: bool
     tables: Dict[str, object] = dataclasses.field(default_factory=dict)
     lru: Optional[SlotLru] = None
+    # Fleet partition state: ``owned[i]`` is this replica's ownership of
+    # dense entity i (None = unsharded type); ``compact_of[i]`` maps a full
+    # entity index to its compacted host row (-1 = row absent host-side).
+    owned: Optional[np.ndarray] = None
+    compact_of: Optional[np.ndarray] = None
 
     @property
     def row_bytes(self) -> int:
@@ -170,6 +227,7 @@ class _ProjGroup:
     num_entities: int
     coords: List[_ProjCoord]
     pinned: bool  # every coordinate fully resident → no promotion path
+    owned: Optional[np.ndarray] = None  # fleet partition mask (no compaction)
 
 
 class HotColdEntityStore:
@@ -188,10 +246,12 @@ class HotColdEntityStore:
         entity_indexes: Optional[Dict] = None,
         hot_bytes: int = 64 << 20,
         min_hot_rows: int = 64,
+        partition: Optional[StorePartition] = None,
     ):
         import jax
 
         self._entity_indexes = dict(entity_indexes or {})
+        self._partition = partition
         self._groups: Dict[str, _ReGroup] = {}
         self._proj_groups: Dict[str, _ProjGroup] = {}
         self._re_subs: Dict[str, RandomEffectModel] = {}
@@ -242,6 +302,31 @@ class HotColdEntityStore:
             cap = max(int(min_hot_rows), share // max(row_bytes, 1))
             pinned = cap >= E
             cap = min(cap, E) if pinned else cap
+            owned = None
+            compact_of = None
+            # Partition applies only to budget-managed (unpinned) types: a
+            # pinned table is fully resident everywhere, so sharding it
+            # would degrade lookups to save nothing.
+            if partition is not None and partition.applies_to(re_type) \
+                    and not pinned:
+                owned = _owned_mask(
+                    partition, self._entity_indexes.get(re_type), E
+                )
+                owned_count = int(owned.sum())
+                # The shard, not the full table, is this replica's working
+                # set: capacity beyond the owned count would never fill.
+                cap = max(int(min_hot_rows), min(cap, max(owned_count, 1)))
+                if partition.compact_host:
+                    sel = np.flatnonzero(owned)
+                    compact_of = np.full(E, -1, np.int32)
+                    compact_of[sel] = np.arange(sel.size, dtype=np.int32)
+                    host = {
+                        cid: np.ascontiguousarray(host[cid][sel])
+                        for cid in host
+                    }
+                reg.gauge(
+                    "serve_store_owned_entities", re_type=re_type
+                ).set(owned_count)
             group = _ReGroup(
                 re_type=re_type,
                 coord_ids=[cid for cid, _ in subs],
@@ -249,6 +334,8 @@ class HotColdEntityStore:
                 num_entities=E,
                 capacity=max(cap, 1),
                 pinned=pinned,
+                owned=owned,
+                compact_of=compact_of,
             )
             if pinned:
                 group.tables = {
@@ -280,6 +367,17 @@ class HotColdEntityStore:
             group = self._build_proj_group(
                 re_type, subs, hot_bytes, budget_total, min_hot_rows
             )
+            # Projected types shard by predicate only (foreign → -1); their
+            # block-structured host masters stay whole — block compaction
+            # would need a remap per block and buys little (the maps are
+            # int32, the blocks are small by construction).
+            if partition is not None and partition.applies_to(re_type) \
+                    and not group.pinned:
+                group.owned = _owned_mask(
+                    partition,
+                    self._entity_indexes.get(re_type),
+                    group.num_entities,
+                )
             self._proj_groups[re_type] = group
             hot = sum(c.hot_bytes for c in group.coords)
             reg.gauge("serve_store_hot_rows", re_type=re_type).set(
@@ -446,6 +544,8 @@ class HotColdEntityStore:
                 dtype=np.int32,
                 count=len(keys),
             )
+            if proj.owned is not None:
+                ids = self._mask_foreign(re_type, proj.owned, None, ids)
             if not proj.pinned:
                 self._promote_projected(proj, ids)
             return ids
@@ -454,6 +554,10 @@ class HotColdEntityStore:
             dtype=np.int64,
             count=len(keys),
         )
+        if group.owned is not None or group.compact_of is not None:
+            ids = self._mask_foreign(
+                re_type, group.owned, group.compact_of, ids
+            )
         if group.pinned:
             return ids.astype(np.int32)
 
@@ -487,6 +591,108 @@ class HotColdEntityStore:
             _oom_contained(re_type, lambda: self._upload(group, misses))
         return slots
 
+    def _mask_foreign(
+        self,
+        re_type: str,
+        owned: Optional[np.ndarray],
+        compact_of: Optional[np.ndarray],
+        ids: np.ndarray,
+    ) -> np.ndarray:
+        """Foreign (non-owned, or owned-but-host-row-absent after a ring
+        rebalance onto a compacted master) entities → -1. They score
+        FE-only — the fleet's degrade-instead-of-error fallback — and are
+        counted per type so the soak can prove correctly routed traffic
+        never takes this path."""
+        pos = np.flatnonzero(ids >= 0)
+        if pos.size == 0:
+            return ids
+        idx = ids[pos].astype(np.int64)
+        servable = (
+            owned[idx] if owned is not None
+            else np.ones(idx.size, bool)
+        )
+        if compact_of is not None:
+            servable = servable & (compact_of[idx] >= 0)
+        foreign = int(pos.size - servable.sum())
+        if foreign:
+            registry().counter(
+                "serve_store_foreign_total", re_type=re_type
+            ).inc(foreign)
+            ids = ids.copy()
+            ids[pos[~servable]] = -1
+        return ids
+
+    def set_partition(self, partition: Optional[StorePartition]) -> None:
+        """Swap the ownership predicate live (ring rebalance / drain).
+        Cheap — only the owned masks recompute; compacted host rows are NOT
+        re-fetched, so an entity newly owned by this replica but absent
+        from its compacted master stays FE-only until the engine rebuilds
+        the store (the reload-based re-home procedure). Hot rows that just
+        became foreign age out of the LRU naturally — they can no longer be
+        requested through resolve. Callers serialize with resolve (the
+        engine's batch lock)."""
+        self._partition = partition
+        for re_type, group in self._groups.items():
+            if group.pinned:
+                continue
+            if partition is not None and partition.applies_to(re_type):
+                group.owned = _owned_mask(
+                    partition,
+                    self._entity_indexes.get(re_type),
+                    group.num_entities,
+                )
+            else:
+                # Unsharded again; compact_of (if any) keeps masking the
+                # rows this replica never had.
+                group.owned = None
+        for re_type, proj in self._proj_groups.items():
+            if (partition is not None and partition.applies_to(re_type)
+                    and not proj.pinned):
+                proj.owned = _owned_mask(
+                    partition,
+                    self._entity_indexes.get(re_type),
+                    proj.num_entities,
+                )
+            else:
+                proj.owned = None
+
+    def partition_stats(self) -> Optional[dict]:
+        """Shard-ownership summary for ``/healthz``'s fleet snapshot."""
+        part = self._partition
+        if part is None:
+            return None
+        types = {}
+        for re_type, group in self._groups.items():
+            if group.owned is None and group.compact_of is None:
+                continue
+            types[re_type] = dict(
+                owned=(
+                    int(group.owned.sum()) if group.owned is not None
+                    else None
+                ),
+                entities=group.num_entities,
+                compacted=group.compact_of is not None,
+                host_rows=(
+                    int(next(iter(group.host_coefs.values())).shape[0])
+                    if group.host_coefs else 0
+                ),
+            )
+        for re_type, proj in self._proj_groups.items():
+            if proj.owned is not None:
+                types[re_type] = dict(
+                    owned=int(proj.owned.sum()),
+                    entities=proj.num_entities,
+                    compacted=False,
+                    projected=True,
+                )
+        return dict(
+            replica_id=part.replica_id,
+            ring_version=part.ring.version,
+            ring_members=len(part.ring),
+            compact_host=part.compact_host,
+            re_types=types,
+        )
+
     def _claim_slot(self, group: _ReGroup, entity: int, in_use: set) -> int:
         # Demotes the least-recently-used entity that is NOT part of the
         # current batch. capacity ≥ max batch size guarantees a victim.
@@ -507,6 +713,10 @@ class HotColdEntityStore:
         idx = np.full(m_b, group.capacity, np.int32)
         idx[:m] = [group.lru.peek(e) for e in entities]
         ent = np.asarray(entities, np.int64)
+        if group.compact_of is not None:
+            # Only servable entities reach here (resolve masked the rest),
+            # so every compacted row index is valid.
+            ent = group.compact_of[ent].astype(np.int64)
         for cid in group.coord_ids:
             host = group.host_coefs[cid]
             rows = np.zeros((m_b, host.shape[1]), np.float32)
@@ -793,6 +1003,7 @@ class HotColdEntityStore:
         new._entity_indexes = self._entity_indexes
         new._re_subs = self._re_subs
         new._proj_groups = self._proj_groups
+        new._partition = self._partition
         base = dict(self._base)
         for cid, means in fixed.items():
             sub = base[cid]
@@ -820,10 +1031,17 @@ class HotColdEntityStore:
             for cid in group.coord_ids:
                 if cid in touched:
                     idx, rows = touched[cid]
+                    idx = np.asarray(idx, np.int64)
+                    rows = np.asarray(rows, np.float32)
+                    if group.compact_of is not None:
+                        # Sharded host master: the delta addresses full
+                        # entity space; rows this replica doesn't hold are
+                        # another replica's to apply.
+                        cidx = group.compact_of[idx].astype(np.int64)
+                        keep = cidx >= 0
+                        idx, rows = cidx[keep], rows[keep]
                     h = group.host_coefs[cid].copy()
-                    h[np.asarray(idx, np.int64)] = np.asarray(
-                        rows, np.float32
-                    )
+                    h[idx] = rows
                     host2[cid] = h
                 else:
                     host2[cid] = group.host_coefs[cid]
@@ -834,6 +1052,8 @@ class HotColdEntityStore:
                 num_entities=group.num_entities,
                 capacity=group.capacity,
                 pinned=group.pinned,
+                owned=group.owned,
+                compact_of=group.compact_of,
             )
             if group.pinned:
                 tables: Dict[str, object] = {}
@@ -922,6 +1142,9 @@ class HotColdEntityStore:
                 pinned=group.pinned,
                 hot_bytes=group.capacity * group.row_bytes,
             )
+            if group.owned is not None:
+                out[re_type]["owned_entities"] = int(group.owned.sum())
+                out[re_type]["compacted_host"] = group.compact_of is not None
         for re_type, proj in self._proj_groups.items():
             out[re_type] = dict(
                 entities=proj.num_entities,

@@ -36,6 +36,7 @@ import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from photon_tpu.utils import faults
@@ -210,6 +211,11 @@ class FeedbackSpool:
         self._part_opened_at = 0.0
         # uid -> (enqueue time, scored record) awaiting its label, FIFO.
         self._pending: "dict" = {}
+        # uids evicted past the join TTL: a label arriving for one of these
+        # is LATE (a measured backfill candidate), not never-seen. Bounded
+        # FIFO so the memory cost mirrors the pending buffer's.
+        self._expired: "OrderedDict[str, float]" = OrderedDict()
+        self._late_logged_seq = -1  # once-per-segment late-label log guard
         self._acc: Dict[str, float] = {}  # per-tenant sampling accumulator
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -273,12 +279,18 @@ class FeedbackSpool:
         while self._pending:
             first_uid = next(iter(self._pending))
             t0, _rec = self._pending[first_uid]
-            if (len(self._pending) > cfg.join_capacity
-                    or now - t0 > cfg.join_ttl_s):
+            over_capacity = len(self._pending) > cfg.join_capacity
+            past_ttl = now - t0 > cfg.join_ttl_s
+            if over_capacity or past_ttl:
                 del self._pending[first_uid]
                 dropped += 1
+                if past_ttl:
+                    self._expired[first_uid] = now
             else:
                 break
+        expired_cap = max(cfg.join_capacity, 1024)
+        while len(self._expired) > expired_cap:
+            self._expired.popitem(last=False)
         if dropped:
             registry().counter("feedback_join_dropped_total").inc(dropped)
 
@@ -314,7 +326,21 @@ class FeedbackSpool:
         with self._lock:
             entry = self._pending.pop(str(uid), None)
             if entry is None:
-                registry().counter("feedback_labels_unmatched_total").inc()
+                if str(uid) in self._expired:
+                    # The scored request WAS here; the label just missed the
+                    # join window. Counted separately from never-seen uids so
+                    # the planned backfill pass has a measured denominator.
+                    registry().counter("feedback_label_late_total").inc()
+                    if self._late_logged_seq != self._seq:
+                        self._late_logged_seq = self._seq
+                        logger.warning(
+                            "feedback: label for uid %s arrived after the "
+                            "%.0fs join TTL; counting in "
+                            "feedback_label_late_total (logged once per "
+                            "segment)", uid, self.config.join_ttl_s,
+                        )
+                else:
+                    registry().counter("feedback_labels_unmatched_total").inc()
                 return False
             _t0, rec = entry
             rec = dict(rec)
@@ -440,6 +466,7 @@ class FeedbackSpool:
         with self._lock:
             return {
                 "pending_joins": len(self._pending),
+                "expired_uids": len(self._expired),
                 "active_records": self._part_records if self._part else 0,
                 "next_seq": self._seq,
                 "sealed": len(sealed_segments(self.directory)),

@@ -34,11 +34,12 @@ which is exactly what the resume-equivalence tests exercise.
 from __future__ import annotations
 
 import dataclasses
+import glob as glob_mod
 import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -53,6 +54,55 @@ from photon_tpu.utils import faults
 logger = logging.getLogger(__name__)
 
 _CURSOR_KEY = "consumedThrough"
+_PER_SPOOL_KEY = "consumedPerSpool"
+
+
+def discover_spool_dirs(spec: str) -> List[str]:
+    """``spool_dir`` may be one directory or a GLOB over several (the fleet
+    shape: each scorer replica spools into ``<base>/<replica-id>``, the
+    updater polls ``<base>/*``). Sorted for deterministic cycle order; a
+    replica joining mid-run is picked up on the next poll with no updater
+    restart."""
+    if is_spool_glob(spec):
+        return sorted(d for d in glob_mod.glob(spec) if os.path.isdir(d))
+    return [spec]
+
+
+def is_spool_glob(spec: str) -> bool:
+    return any(ch in spec for ch in "*?[")
+
+
+def spool_dir_key(path: str) -> str:
+    """Stable manifest key for one spool directory (its basename — the
+    replica id in the fleet layout)."""
+    return os.path.basename(os.path.normpath(path))
+
+
+def merge_pending_segments(
+    dirs: Sequence[str],
+    cursors: Dict[str, int],
+    max_segments: int,
+) -> List[Tuple[str, str]]:
+    """Unconsumed sealed segments across every spool dir, merged in mtime
+    order (seal time ≈ label arrival time, so records from N replicas
+    interleave roughly chronologically). Ties break on (dir key, seq).
+    Within one dir mtimes are monotone in seq, so ANY prefix of the merged
+    order contains a per-dir seq prefix — the per-dir cursors stay sound
+    under the ``max_segments`` cap."""
+    entries: List[Tuple[float, str, int, str, str]] = []
+    for d in dirs:
+        cursor = cursors.get(spool_dir_key(d), 0)
+        for fn in sealed_segments(d):
+            seq = segment_seq(fn)
+            if seq <= cursor:
+                continue
+            try:
+                mtime = os.path.getmtime(os.path.join(d, fn))
+            except OSError:
+                continue  # consumed and pruned between listdir and stat
+            entries.append((mtime, spool_dir_key(d), seq, d, fn))
+    entries.sort()
+    return [(d, fn) for _, _, _, d, fn in entries[:max_segments]]
 
 
 @dataclasses.dataclass
@@ -63,7 +113,7 @@ class StreamingUpdaterConfig:
     estimator, just on spool-fed micro-batches."""
 
     publish_root: str
-    spool_dir: str
+    spool_dir: str  # one directory, or a glob over per-replica spool dirs
     task: object
     coordinate_configs: Sequence
     update_sequence: Sequence[str]
@@ -199,32 +249,60 @@ class StreamingUpdater:
 
     # -- cursor ------------------------------------------------------------
 
-    def consumed_through(self) -> int:
-        """Highest spool segment sequence already folded into the published
-        model lineage: walk parent links from ``LATEST`` and return the
-        first ``stream.consumedThrough`` found. A full (batch) publish
-        interleaved into the lineage carries no stream record and is walked
-        through — its parent chain still reaches the last streaming
-        generation."""
+    def _cursor_stream_info(self) -> Dict:
+        """The most recent ``stream`` manifest block in the published
+        lineage: walk parent links from ``LATEST`` and return the first
+        block carrying a cursor. A full (batch) publish interleaved into
+        the lineage carries no stream record and is walked through — its
+        parent chain still reaches the last streaming generation."""
         from photon_tpu.cli.game_serving import resolve_model_dir
         from photon_tpu.io.model_io import load_generation_manifest
 
         root = self.config.publish_root
         cur = resolve_model_dir(root)
         if cur == root:
-            return 0
+            return {}
         for _ in range(128):
             manifest = load_generation_manifest(cur) or {}
             stream = manifest.get("stream") or {}
-            if _CURSOR_KEY in stream:
-                return int(stream[_CURSOR_KEY])
+            if _CURSOR_KEY in stream or _PER_SPOOL_KEY in stream:
+                return stream
             parent = manifest.get("parent")
             if not parent:
-                return 0
+                return {}
             cur = os.path.join(root, parent)
             if not os.path.isdir(cur):
-                return 0
-        return 0
+                return {}
+        return {}
+
+    def consumed_through(self) -> int:
+        """Highest spool segment sequence already folded into the published
+        model lineage (max across spool dirs in the fleet layout — the
+        legacy single-dir cursor reads identically)."""
+        stream = self._cursor_stream_info()
+        if _CURSOR_KEY in stream:
+            return int(stream[_CURSOR_KEY])
+        per_spool = stream.get(_PER_SPOOL_KEY) or {}
+        return max((int(v) for v in per_spool.values()), default=0)
+
+    def consumed_per_spool(self) -> Dict[str, int]:
+        """Per-spool-dir cursors (keyed by dir basename = replica id). A
+        legacy manifest carrying only the scalar cursor applies it to a
+        single configured dir; against a multi-dir glob it contributes
+        nothing (each dir starts from its own recorded cursor or 0)."""
+        stream = self._cursor_stream_info()
+        per_spool = {
+            str(k): int(v)
+            for k, v in (stream.get(_PER_SPOOL_KEY) or {}).items()
+        }
+        if (
+            not per_spool and _CURSOR_KEY in stream
+            and not is_spool_glob(self.config.spool_dir)
+        ):
+            per_spool[spool_dir_key(self.config.spool_dir)] = int(
+                stream[_CURSOR_KEY]
+            )
+        return per_spool
 
     # -- one cycle ---------------------------------------------------------
 
@@ -237,18 +315,27 @@ class StreamingUpdater:
         from photon_tpu.train.incremental import incremental_update
 
         cfg = self.config
-        recover_orphan_parts(cfg.spool_dir)
-        cursor = self.consumed_through()
+        dirs = discover_spool_dirs(cfg.spool_dir)
+        for d in dirs:
+            recover_orphan_parts(d)
+        cursors = self.consumed_per_spool()
+        # A glob spec is "multi" even when it currently matches one dir —
+        # more replica spools may appear later, so per-spool cursors (and
+        # dir-qualified segment names) are needed from the first cycle.
+        multi = len(dirs) > 1 or is_spool_glob(cfg.spool_dir)
+        pending_pairs = merge_pending_segments(
+            dirs, cursors, cfg.max_segments_per_cycle
+        )
         pending = [
-            fn for fn in sealed_segments(cfg.spool_dir)
-            if segment_seq(fn) > cursor
-        ][: cfg.max_segments_per_cycle]
-        if not pending:
+            f"{spool_dir_key(d)}/{fn}" if multi else fn
+            for d, fn in pending_pairs
+        ]
+        if not pending_pairs:
             return None
         records: List[dict] = []
-        for fn in pending:
+        for d, fn in pending_pairs:
             faults.check("stream.consume", label=fn)
-            records.extend(read_segment(os.path.join(cfg.spool_dir, fn)))
+            records.extend(read_segment(os.path.join(d, fn)))
         if len(records) < cfg.min_records:
             return None
         self._cycles += 1
@@ -282,7 +369,14 @@ class StreamingUpdater:
                 {k: len(v) for k, v in self.entity_indexes.items()},
             )
 
-        consumed = max(segment_seq(fn) for fn in pending)
+        # Per-dir cursors advance to the max seq consumed THIS cycle; dirs
+        # with nothing new carry their prior cursor forward (an idle
+        # replica's cursor must never regress to 0).
+        new_cursors = dict(cursors)
+        for d, fn in pending_pairs:
+            key = spool_dir_key(d)
+            new_cursors[key] = max(new_cursors.get(key, 0), segment_seq(fn))
+        consumed = max(new_cursors.values())
         label_ts = [
             float(r["labelTs"]) for r in records if r.get("labelTs")
         ]
@@ -295,6 +389,10 @@ class StreamingUpdater:
             "segments": pending,
             "records": len(records),
         }
+        if multi:
+            # Only the multi-dir (fleet) layout needs per-spool cursors;
+            # single-dir manifests keep the PR 11 shape byte-for-byte.
+            stream_info[_PER_SPOOL_KEY] = new_cursors
         if oldest_label_ts is not None:
             stream_info["oldestLabelTs"] = oldest_label_ts
 

@@ -13,7 +13,12 @@ injector with named hook points in the ingest pipeline
 (``serve.feedback`` — the spool's label-join/segment writer, where ``torn``
 tears the active segment mid-record and ``enospc`` drops the join — and
 ``stream.consume`` — the updater's per-segment read and pre-train step,
-where ``kill`` crashes the updater mid-cycle).
+where ``kill`` crashes the updater mid-cycle). The scorer fleet adds
+``serve.replica_kill``: fired from each replica's main-thread heartbeat
+(labelled with the replica id, targeted per replica by setting
+``PHOTON_TPU_FAULT_PLAN`` in that replica's environment), where ``kill``
+SIGKILLs the whole replica mid-traffic — the failover drill that proves a
+dead member's shard degrades to FE-only scoring instead of erroring.
 
 A **plan** is JSON — inline or a file path — selected by the
 ``PHOTON_TPU_FAULT_PLAN`` environment variable (or programmatically via

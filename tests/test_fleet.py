@@ -1,0 +1,575 @@
+"""Scorer-fleet tests: ring stability + cross-process determinism, the
+partition-aware hot/cold store, the fleet-global admission ledger, the
+per-replica spool satellites, and one end-to-end 3-replica drill
+(parity vs the batch path, SIGKILL failover to FE-only, revive re-home).
+
+The ring assertions pin the two properties the whole subsystem leans on:
+(1) same (members, vnodes, seed) snapshot → same assignment in ANY process
+(blake2b, no Python hash randomization), and (2) a single join/leave moves
+≤ 1/N + ε of keys (consistent hashing's contract — anything more would
+dump whole shards' hot sets on every membership change).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu.obs.metrics import registry
+from photon_tpu.serve.routing import (
+    HashRing,
+    moved_keys,
+    route_key,
+    stable_hash,
+)
+from photon_tpu.serve.store import HotColdEntityStore, StorePartition
+
+from test_serving import (  # the shared serving fixtures
+    D_FIX,
+    D_RE,
+    N_ENTITIES,
+    batch_scores,
+    make_entity_index,
+    make_model,
+)
+
+KEYS = [f"user{i}" for i in range(2000)]
+
+
+# ---------------------------------------------------------------------------
+# Ring properties
+# ---------------------------------------------------------------------------
+
+
+def test_stable_hash_is_process_stable_and_seeded():
+    # Pinned values: blake2b output must never drift across versions — a
+    # drift would silently re-shard every fleet on upgrade.
+    assert stable_hash("user0", 0) == stable_hash("user0", 0)
+    assert stable_hash("user0", 0) != stable_hash("user0", 1)
+    assert stable_hash("user0", 0) != stable_hash("user1", 0)
+    code = (
+        "from photon_tpu.serve.routing import stable_hash;"
+        "print(stable_hash('user0', 0), stable_hash('user0', 7))"
+    )
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
+    ))
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, check=True,
+    ).stdout.split()
+    assert int(out[0]) == stable_hash("user0", 0)
+    assert int(out[1]) == stable_hash("user0", 7)
+
+
+def test_ring_assignment_deterministic_across_processes():
+    ring = HashRing(["r0", "r1", "r2"], vnodes=64, seed=3)
+    snap = json.dumps(ring.snapshot())
+    code = (
+        "import json,sys;"
+        "from photon_tpu.serve.routing import HashRing;"
+        "r=HashRing.from_snapshot(json.loads(sys.argv[1]));"
+        "print(json.dumps([r.owner(f'user{i}') for i in range(200)]))"
+    )
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
+    ))
+    out = subprocess.run(
+        [sys.executable, "-c", code, snap], capture_output=True, text=True,
+        env=env, check=True,
+    ).stdout
+    assert json.loads(out) == [ring.owner(f"user{i}") for i in range(200)]
+
+
+def test_ring_snapshot_canonical_regardless_of_join_order():
+    a = HashRing(["r0", "r1", "r2"], vnodes=32, seed=1)
+    b = HashRing(["r2", "r0", "r1"], vnodes=32, seed=1)
+    assert a.snapshot() == b.snapshot()
+    assert [a.owner(k) for k in KEYS[:200]] == [b.owner(k) for k in KEYS[:200]]
+
+
+def test_ring_join_moves_at_most_one_share_plus_eps():
+    before = HashRing([f"r{i}" for i in range(4)], vnodes=64, seed=0)
+    after = HashRing([f"r{i}" for i in range(5)], vnodes=64, seed=0)
+    moved = moved_keys(before, after, KEYS)
+    # Ideal: 1/5 of keys move (all TO the newcomer). ε covers vnode
+    # placement variance at 64 vnodes.
+    assert len(moved) / len(KEYS) <= 1 / 5 + 0.08
+    assert all(after.owner(k) == "r4" for k in moved)
+
+
+def test_ring_leave_moves_only_the_departed_shard():
+    before = HashRing([f"r{i}" for i in range(4)], vnodes=64, seed=0)
+    after = HashRing.from_snapshot(before.snapshot())
+    after.remove("r1")
+    moved = moved_keys(before, after, KEYS)
+    assert len(moved) / len(KEYS) <= 1 / 4 + 0.08
+    # Exactly the departed member's keys move; everyone else's stay put.
+    assert all(before.owner(k) == "r1" for k in moved)
+    assert sum(1 for k in KEYS if before.owner(k) == "r1") == len(moved)
+
+
+def test_ring_balance_and_shard_ranges():
+    ring = HashRing(["r0", "r1", "r2"], vnodes=128, seed=0)
+    owners = [ring.owner(k) for k in KEYS]
+    for m in ring.members:
+        share = owners.count(m) / len(KEYS)
+        assert 1 / 3 - 0.12 < share < 1 / 3 + 0.12
+    ranges = ring.shard_ranges()
+    assert set(ranges) == {"r0", "r1", "r2"}
+    assert abs(sum(r["fraction"] for r in ranges.values()) - 1.0) < 1e-6
+
+
+def test_ring_preference_starts_at_owner_and_covers_members():
+    ring = HashRing(["r0", "r1", "r2", "r3"], vnodes=64, seed=0)
+    for k in KEYS[:100]:
+        pref = ring.preference(k)
+        assert pref[0] == ring.owner(k)
+        assert sorted(pref) == ["r0", "r1", "r2", "r3"]
+
+
+def test_route_key_prefers_routing_type():
+    assert route_key({"userId": "u1", "adId": "a9"}, "userId") == "u1"
+    # Routing type absent: deterministic fallback (lexicographically first).
+    assert route_key({"zz": "z1", "adId": "a9"}, "userId") == "a9"
+    assert route_key({}, "userId") is None
+    assert route_key(None, None) is None
+    assert route_key({"userId": 7}, "userId") == "7"
+
+
+# ---------------------------------------------------------------------------
+# Partition-aware store
+# ---------------------------------------------------------------------------
+
+
+def _ring2():
+    return HashRing(["A", "B"], vnodes=64, seed=0)
+
+
+def _owned_users(ring, member):
+    return [
+        e for e in range(N_ENTITIES) if ring.owner(f"user{e}") == member
+    ]
+
+
+def test_partitioned_store_masks_foreign_entities():
+    ring = _ring2()
+    model = make_model()
+    w_re = np.asarray(model.models["per_user"].coefficients)
+    store = HotColdEntityStore(
+        model, {"userId": make_entity_index()},
+        hot_bytes=1, min_hot_rows=8,
+        partition=StorePartition("A", ring, re_types=("userId",)),
+    )
+    mine = _owned_users(ring, "A")[:6]
+    theirs = _owned_users(ring, "B")[:6]
+    slots = store.resolve("userId", [f"user{e}" for e in mine + theirs])
+    assert all(s >= 0 for s in slots[: len(mine)])
+    assert all(s == -1 for s in slots[len(mine):])  # foreign → FE-only
+    table = np.asarray(store.scoring_model().models["per_user"].coefficients)
+    for e, s in zip(mine, slots):
+        np.testing.assert_array_equal(table[s], w_re[e])
+    foreign = registry().find("serve_store_foreign_total", re_type="userId")
+    assert foreign is not None and foreign.value >= len(theirs)
+    stats = store.partition_stats()
+    assert stats["replica_id"] == "A" and stats["ring_members"] == 2
+    assert stats["re_types"]["userId"]["owned"] == len(_owned_users(ring, "A"))
+    assert stats["re_types"]["userId"]["compacted"]
+
+
+def test_partitioned_stores_are_disjoint_and_cover_everything():
+    ring = _ring2()
+    owned = {
+        m: set(_owned_users(ring, m)) for m in ("A", "B")
+    }
+    assert not (owned["A"] & owned["B"])
+    assert owned["A"] | owned["B"] == set(range(N_ENTITIES))
+    # And the stores agree with the ring exactly.
+    for member in ("A", "B"):
+        store = HotColdEntityStore(
+            make_model(), {"userId": make_entity_index()},
+            hot_bytes=1, min_hot_rows=40,
+            partition=StorePartition(member, ring, re_types=("userId",)),
+        )
+        for e in list(owned[member])[:10]:
+            assert store.resolve("userId", [f"user{e}"])[0] >= 0
+        other = "B" if member == "A" else "A"
+        for e in list(owned[other])[:10]:
+            assert store.resolve("userId", [f"user{e}"])[0] == -1
+
+
+def test_partition_compacts_host_master():
+    ring = _ring2()
+    n_owned = len(_owned_users(ring, "A"))
+    store = HotColdEntityStore(
+        make_model(), {"userId": make_entity_index()},
+        hot_bytes=1, min_hot_rows=8,
+        partition=StorePartition("A", ring, re_types=("userId",)),
+    )
+    stats = store.partition_stats()["re_types"]["userId"]
+    # The OOC host master holds ~1/N of the rows, keyed by the same hash.
+    assert stats["host_rows"] == n_owned < N_ENTITIES
+
+
+def test_set_partition_swaps_ownership_live():
+    ring = _ring2()
+    store = HotColdEntityStore(
+        make_model(), {"userId": make_entity_index()},
+        hot_bytes=1, min_hot_rows=8,
+        # compact_host=False so a later rebalance can re-home without a
+        # store rebuild (rows are all still host-side).
+        partition=StorePartition(
+            "A", ring, re_types=("userId",), compact_host=False
+        ),
+    )
+    mine = _owned_users(ring, "A")[0]
+    theirs = _owned_users(ring, "B")[0]
+    assert store.resolve("userId", [f"user{mine}"])[0] >= 0
+    assert store.resolve("userId", [f"user{theirs}"])[0] == -1
+    # The ring shrinks to just this replica: everything becomes ours.
+    solo = HashRing(["A"], vnodes=64, seed=0)
+    store.set_partition(
+        StorePartition("A", solo, re_types=("userId",), compact_host=False)
+    )
+    assert store.resolve("userId", [f"user{theirs}"])[0] >= 0
+
+
+def test_partitioned_scores_match_batch_reference():
+    rng = np.random.default_rng(7)
+    ring = _ring2()
+    model = make_model()
+    from photon_tpu.serve import ScoreRequest, ServeConfig, ServingEngine
+
+    engine = ServingEngine(
+        model, entity_indexes={"userId": make_entity_index()},
+        config=ServeConfig(max_batch_size=8, max_delay_ms=1.0, hot_bytes=1),
+        partition=StorePartition("A", ring, re_types=("userId",)),
+    )
+    try:
+        mine = _owned_users(ring, "A")[:8]
+        xa = rng.normal(size=(len(mine), D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(len(mine), D_RE)).astype(np.float32)
+        ref = batch_scores(model, xa, xb, mine)
+        futs = [
+            engine.submit(ScoreRequest(
+                features={"shardA": xa[i], "shardB": xb[i]},
+                entity_ids={"userId": f"user{e}"},
+            ))
+            for i, e in enumerate(mine)
+        ]
+        got = np.array([f.result(30) for f in futs], np.float32)
+        # Owned entities score bit-identical to the batch driver.
+        np.testing.assert_array_equal(got, ref)
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet-global admission ledger
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_ledger_sheds_like_single_process_admission():
+    from photon_tpu.serve.admission import (
+        AdmissionConfig,
+        FleetAdmissionLedger,
+        QuotaExceededError,
+    )
+
+    clock = [0.0]
+    ledger = FleetAdmissionLedger(
+        AdmissionConfig(tenant_qps={"abuser": 2.0}, tenant_burst={"abuser": 2.0}),
+        clock=lambda: clock[0],
+    )
+    # The abusive tenant gets exactly its burst, fleet-wide — there is ONE
+    # bucket no matter how many replicas will execute the work.
+    admitted = shed = 0
+    for _ in range(10):
+        try:
+            ledger.admit("abuser", "interactive")
+            admitted += 1
+        except QuotaExceededError:
+            shed += 1
+    assert admitted == 2 and shed == 8
+    ledger.admit("anyone-else", "interactive")  # unnamed tenants unlimited
+    snap = ledger.fleet_snapshot()
+    assert snap["tenants"]["abuser"]["shed"] == 8
+    assert snap["tenants"]["abuser"]["admitted"] == 2
+
+
+def test_fleet_ledger_tracks_per_replica_inflight():
+    from photon_tpu.serve.admission import FleetAdmissionLedger
+
+    ledger = FleetAdmissionLedger()
+    ledger.begin("r0")
+    ledger.begin("r0")
+    ledger.begin("r1")
+    assert ledger.inflight("r0") == 2
+    assert ledger.inflight() == 3
+    ledger.end("r0")
+    ledger.end("r1")
+    assert ledger.inflight("r0") == 1 and ledger.inflight("r1") == 0
+    assert ledger.fleet_snapshot()["inflight"] == {"r0": 1}
+
+
+# ---------------------------------------------------------------------------
+# Metrics default labels (the `replica` label satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_default_labels_merge_and_reset():
+    from photon_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.set_default_labels(replica="r7")
+    reg.counter("fleet_test_total", op="score").inc()
+    inst = reg.find("fleet_test_total", op="score")
+    assert inst is not None and inst.label_dict() == {
+        "op": "score", "replica": "r7",
+    }
+    # Explicit label wins on collision.
+    reg.counter("fleet_test_total", replica="override").inc()
+    assert reg.find("fleet_test_total", replica="override") is not None
+    reg.reset()
+    assert reg.default_labels() == {}
+
+
+# ---------------------------------------------------------------------------
+# Spool late labels + multi-dir updater merge (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_spool_counts_late_labels_separately(tmp_path):
+    from photon_tpu.stream.spool import FeedbackSpool, SpoolConfig
+
+    def _count(name):
+        inst = registry().find(name)
+        return inst.value if inst is not None else 0
+
+    spool = FeedbackSpool(
+        str(tmp_path / "spool"),
+        SpoolConfig(join_ttl_s=0.01, segment_max_age_s=60.0),
+    )
+    try:
+        late0 = _count("feedback_label_late_total")
+        unmatched0 = _count("feedback_labels_unmatched_total")
+        assert spool.observe_scored("uid-late", score=0.5)
+        time.sleep(0.03)
+        spool.tick()  # TTL eviction moves uid-late to the expired set
+        assert not spool.observe_label("uid-late", 1.0)  # late, not unknown
+        assert not spool.observe_label("uid-never-seen", 1.0)
+        assert _count("feedback_label_late_total") == late0 + 1
+        assert _count("feedback_labels_unmatched_total") == unmatched0 + 1
+        assert spool.stats()["expired_uids"] >= 1
+    finally:
+        spool.close()
+
+
+def _write_sealed(directory, seq, records, mtime):
+    from photon_tpu.stream.spool import _sealed_name
+
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, _sealed_name(seq))
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    os.utime(path, (mtime, mtime))
+    return os.path.basename(path)
+
+
+def test_updater_merges_spool_dirs_in_mtime_order(tmp_path):
+    from photon_tpu.stream.updater import (
+        discover_spool_dirs,
+        is_spool_glob,
+        merge_pending_segments,
+        spool_dir_key,
+    )
+
+    base = tmp_path / "spools"
+    r0, r1 = str(base / "r0"), str(base / "r1")
+    s_a = _write_sealed(r0, 1, [{"uid": "a"}], mtime=100.0)
+    s_b = _write_sealed(r1, 1, [{"uid": "b"}], mtime=50.0)
+    s_c = _write_sealed(r0, 2, [{"uid": "c"}], mtime=150.0)
+    s_d = _write_sealed(r1, 2, [{"uid": "d"}], mtime=120.0)
+
+    spec = str(base / "*")
+    assert is_spool_glob(spec)
+    dirs = discover_spool_dirs(spec)
+    assert [spool_dir_key(d) for d in dirs] == ["r0", "r1"]
+
+    merged = merge_pending_segments(dirs, {}, max_segments=10)
+    assert [(spool_dir_key(d), fn) for d, fn in merged] == [
+        ("r1", s_b), ("r0", s_a), ("r1", s_d), ("r0", s_c),
+    ]
+    # The cap takes a PREFIX of the merged order — per-dir seq prefixes
+    # stay intact, so per-dir cursors remain sound.
+    capped = merge_pending_segments(dirs, {}, max_segments=2)
+    assert [(spool_dir_key(d), fn) for d, fn in capped] == [
+        ("r1", s_b), ("r0", s_a),
+    ]
+    # Per-dir cursors filter independently.
+    after = merge_pending_segments(dirs, {"r0": 1, "r1": 2}, max_segments=10)
+    assert [(spool_dir_key(d), fn) for d, fn in after] == [("r0", s_c)]
+
+
+def test_updater_single_dir_remains_legacy_shaped(tmp_path):
+    # A plain (non-glob) spool_dir must keep the PR 11 manifest shape —
+    # scalar consumedThrough only — via the compatibility fallback.
+    from photon_tpu.stream.updater import (
+        discover_spool_dirs,
+        is_spool_glob,
+        spool_dir_key,
+    )
+
+    d = str(tmp_path / "solo")
+    assert not is_spool_glob(d)
+    assert discover_spool_dirs(d) == [d]
+    assert spool_dir_key(d) == "solo"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: 3 replicas, parity, SIGKILL failover, revive re-home
+# ---------------------------------------------------------------------------
+
+
+def _score_request(xa_row, xb_row, user, uid=None):
+    return {
+        "features": {
+            "shardA": {f"a{j}": float(xa_row[j]) for j in range(D_FIX)},
+            "shardB": {f"b{j}": float(xb_row[j]) for j in range(D_RE)},
+        },
+        "entityIds": {"userId": f"user{user}"},
+        **({"uid": uid} if uid else {}),
+    }
+
+
+def test_fleet_three_replicas_parity_kill_revive(tmp_path):
+    from test_serving import _publish_generation
+
+    from photon_tpu.serve.fleet import FleetBackend, ScorerFleet
+
+    root = str(tmp_path / "pub")
+    os.makedirs(root)
+    model = _publish_generation(root, "gen-1", 1.0)
+    fleet = ScorerFleet(
+        os.path.join(root, "gen-1"), str(tmp_path / "work"),
+        artifacts_dir=root, route_re_type="userId",
+        hot_bytes=1,  # force an unpinned, genuinely sharded store
+        max_batch_size=8, max_delay_ms=1.0,
+        spool_base=str(tmp_path / "spool"),
+    )
+    try:
+        fleet.start(["r0", "r1", "r2"])
+        backend = FleetBackend(fleet.router)
+        rng = np.random.default_rng(11)
+        n = 32
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        users = np.arange(n) % N_ENTITIES
+        ref = batch_scores(model, xa, xb, users)
+        ref_fe = batch_scores(
+            model, xa, np.zeros_like(xb), np.full(n, -1)
+        )
+
+        def score_all():
+            futs = [
+                backend.submit(
+                    _score_request(xa[i], xb[i], users[i], uid=f"u{i}"),
+                    "tenantA", "interactive",
+                )
+                for i in range(n)
+            ]
+            out, errors, used = np.zeros(n, np.float32), 0, set()
+            for i, f in enumerate(futs):
+                try:
+                    res = f.result(60)
+                    out[i] = res["score"]
+                    used.add(res["replica"])
+                except Exception:  # noqa: BLE001 — counted, asserted zero
+                    errors += 1
+            return out, errors, used
+
+        # Healthy fleet: bit parity with the batch driver, all 3 serving.
+        got, errors, used = score_all()
+        assert errors == 0 and used == {"r0", "r1", "r2"}
+        np.testing.assert_array_equal(got, ref)
+
+        # /healthz fleet snapshot + disjoint shard evidence.
+        snap = fleet.fleet_snapshot()
+        assert snap["states"] == {m: "live" for m in ("r0", "r1", "r2")}
+        assert set(snap["shardRanges"]) == {"r0", "r1", "r2"}
+        stats = fleet.router.replica_stats()
+        owned = {
+            rid: s["partition"]["re_types"]["userId"]["owned"]
+            for rid, s in stats.items()
+        }
+        assert sum(owned.values()) == N_ENTITIES  # disjoint cover
+        assert all(v < N_ENTITIES for v in owned.values())
+
+        # Feedback follows each uid to the replica that scored it.
+        fb = backend.feedback(
+            {"labels": [{"uid": f"u{i}", "label": 1.0} for i in range(n)]}
+        )
+        assert fb["joined"] == n and fb["dropped"] == 0
+
+        # SIGKILL drill: zero caller errors; the dead member's keys score
+        # FE-only (their RE rows are foreign everywhere else), everyone
+        # else's stay exact.
+        fleet.kill("r1")
+        got2, errors2, used2 = score_all()
+        assert errors2 == 0 and "r1" not in used2
+        r1_keys = [
+            i for i in range(n)
+            if fleet.ring.owner(f"user{users[i]}") == "r1"
+        ]
+        assert r1_keys, "seed must give r1 a share of the test keys"
+        for i in range(n):
+            expect = ref_fe[i] if i in r1_keys else ref[i]
+            assert got2[i] == expect, (i, got2[i], ref[i], ref_fe[i])
+
+        # Revive: same id, same ring — exact scores re-home.
+        fleet.revive("r1")
+        got3, errors3, used3 = score_all()
+        assert errors3 == 0 and "r1" in used3
+        np.testing.assert_array_equal(got3, ref)
+
+        # HTTP surface: /v1/score routes through the ring and /healthz
+        # carries the fleet block (ring version, shard ranges, states).
+        import http.client
+
+        from photon_tpu.serve.fleet import FleetHTTPFrontend
+
+        http_fe = FleetHTTPFrontend(backend).start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", http_fe.port, timeout=30
+            )
+            conn.request(
+                "POST", "/v1/score",
+                body=json.dumps(_score_request(xa[0], xb[0], users[0])),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            body = json.loads(resp.read())
+            assert np.float32(body["score"]) == ref[0]
+            assert body["replica"] in {"r0", "r1", "r2"}
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            assert health["fleet"]["ringVersion"] == fleet.ring.version
+            assert set(health["fleet"]["shardRanges"]) == {"r0", "r1", "r2"}
+            assert health["fleet"]["states"]["r1"] == "live"
+            conn.close()
+        finally:
+            http_fe.close()
+
+        # Per-replica spool dirs exist for the updater's glob.
+        assert {"r0", "r1", "r2"} <= set(os.listdir(str(tmp_path / "spool")))
+    finally:
+        fleet.shutdown()
