@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|fleet|rollout|streaming|exhaustion|install|kernels|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|fleet|rollout|streaming|exhaustion|obs|install|kernels|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -548,6 +548,23 @@ run_exhaustion() {
     echo "   exhaustion-soak smoke OK"
 }
 
+run_obs() {
+    # Observability plane: W3C-style trace context propagated worker →
+    # relay → replica (ONE trace, spans from ≥3 pids, correct nesting),
+    # the tail-based flight recorder + /v1/traces, fleet-merged /metrics
+    # with per-replica labels, metric-name aliases, and the SLO burn-rate
+    # state machine (tests/test_obs_plane.py asserts the ISSUE 14 bar
+    # itself). Then the tracing-on vs tracing-off serve A/B: median
+    # per-pass p99 overhead <= 5%, zero post-warmup retraces with the
+    # recorder on, and the sync-free telemetry pin re-asserted.
+    echo "== obs: cross-process tracing + fleet /metrics + SLO plane =="
+    JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+        tests/test_obs_plane.py
+    echo "   obs plane tests OK"
+    JAX_PLATFORMS=cpu python bench.py --obs-overhead-ab
+    echo "   obs overhead A/B OK"
+}
+
 run_kernels() {
     # Kernel-surface smoke: interpret-mode parity for both Pallas kernel
     # families (FE fused value+grad/HVP, RE batched Newton system), and a
@@ -596,7 +613,8 @@ run_install() {
     for cmd in photon-tpu-game-training photon-tpu-game-scoring \
                photon-tpu-train-glm photon-tpu-feature-indexing \
                photon-tpu-name-and-term-bags photon-tpu-game-serving \
-               photon-tpu-game-incremental photon-tpu-game-streaming; do
+               photon-tpu-game-incremental photon-tpu-game-streaming \
+               photon-tpu-obs; do
         PYTHONPATH="$parent_site" "$tmp/venv/bin/$cmd" --help > /dev/null
         echo "   $cmd --help OK"
     done
@@ -620,7 +638,8 @@ case "$stage" in
     exhaustion) run_exhaustion ;;
     install) run_install ;;
     kernels) run_kernels ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_fleet; run_rollout; run_streaming; run_exhaustion; run_kernels; run_unit ;;
+    obs) run_obs ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_fleet; run_rollout; run_streaming; run_exhaustion; run_obs; run_kernels; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

@@ -651,7 +651,7 @@ class RandomEffectCoordinate(Coordinate):
         reg = registry()
         labels = dict(coordinate=self.coordinate_id)
         reg.gauge("re_entities_active", **labels).set(dispatched_valid)
-        reg.counter("re_entities_skipped", **labels).inc(skipped)
+        reg.counter("re_entities_skipped_total", **labels).inc(skipped)
         reg.histogram("re_compaction_ratio", **labels).observe(ratio)
         self.last_active_set_stats = dict(
             cd_pass=self._cd_pass,

@@ -267,7 +267,7 @@ def _observe_staleness(target: str) -> None:
 
     lag = max(0.0, time.time() - float(ts))
     registry().gauge("model_staleness_s").set(lag)
-    registry().histogram("model_staleness_s_hist").observe(lag)
+    registry().histogram("model_staleness_hist_s").observe(lag)
 
 
 def _try_delta_install(engine, target: str) -> bool:

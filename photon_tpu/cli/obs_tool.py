@@ -1,0 +1,257 @@
+"""photon-tpu-obs: read the serving observability plane from a terminal.
+
+Thin stdlib-only client for the three observability endpoints every
+deployment shape serves (in-process, ``--workers N``, fleet front end):
+
+- ``traces``  — ``GET /v1/traces``: the tail-based flight recorder's kept
+  span trees (slow / errored / degraded / client-forced requests), merged
+  across processes by trace id and printed as indented trees with the pid
+  of the process each span ran in. ``--follow`` polls and prints only
+  traces it has not shown yet.
+- ``metrics`` — ``GET /metrics``: the fleet-merged Prometheus text
+  exposition, optionally filtered to a name prefix.
+- ``slo``     — ``GET /healthz``: the SLO block (per-objective burn rates
+  and ok/warn/page state) plus the telemetry-sink health block.
+
+Deliberately free of photon_tpu imports at module level: ``--help`` and a
+scrape against a remote host must work without jax or the model stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def _get(url: str, timeout_s: float = 30.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310
+        return resp.read()
+
+
+def _get_json(url: str, timeout_s: float = 30.0):
+    return json.loads(_get(url, timeout_s).decode())
+
+
+# ---------------------------------------------------------------------------
+# traces
+# ---------------------------------------------------------------------------
+
+
+def _span_children(spans: List[dict]) -> Dict[Optional[str], List[dict]]:
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    ids = {s.get("spanId") for s in spans}
+    for s in spans:
+        parent = s.get("parentSpanId")
+        # A span whose parent was recorded in a process we could not
+        # scrape still prints — promoted to a root rather than dropped.
+        if parent not in ids:
+            parent = None
+        by_parent.setdefault(parent, []).append(s)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s.get("start_s") or 0.0)
+    return by_parent
+
+def _format_span(s: dict, depth: int) -> str:
+    dur = s.get("duration_s")
+    dur_txt = f"{dur * 1000:.2f}ms" if isinstance(dur, (int, float)) else "?"
+    return (
+        f"  {'  ' * depth}{s.get('name', '?')}  {dur_txt}"
+        f"  [pid {s.get('pid', '?')}  span {s.get('spanId', '?')}]"
+    )
+
+
+def format_trace(entry: dict) -> str:
+    lat = entry.get("latencySeconds")
+    lat_txt = f"{lat * 1000:.2f}ms" if isinstance(lat, (int, float)) else "?"
+    head = (
+        f"trace {entry.get('traceId', '?')}  reason={entry.get('reason', '?')}"
+        f"  latency={lat_txt}  pids={entry.get('pids', [])}"
+    )
+    if entry.get("error"):
+        head += f"  error={entry['error']!r}"
+    if entry.get("degraded"):
+        head += "  degraded"
+    lines = [head]
+    spans = entry.get("spans") or []
+    by_parent = _span_children(spans)
+    seen = set()
+
+    def _walk(parent: Optional[str], depth: int) -> None:
+        for s in by_parent.get(parent, []):
+            sid = s.get("spanId")
+            if sid in seen:
+                continue
+            seen.add(sid)
+            lines.append(_format_span(s, depth))
+            if sid is not None:
+                _walk(sid, depth + 1)
+
+    _walk(None, 0)
+    return "\n".join(lines)
+
+
+def cmd_traces(args: argparse.Namespace) -> int:
+    url = args.url.rstrip("/") + "/v1/traces"
+    if args.limit is not None:
+        url += "?" + urllib.parse.urlencode({"limit": args.limit})
+    shown = set()
+    while True:
+        try:
+            payload = _get_json(url)
+        except (urllib.error.URLError, OSError) as exc:
+            print(f"photon-tpu-obs: {url}: {exc}", file=sys.stderr)
+            return 1
+        entries = payload.get("traces") or []
+        fresh = [e for e in entries if e.get("traceId") not in shown]
+        for e in fresh:
+            shown.add(e.get("traceId"))
+            if args.json:
+                print(json.dumps(e))
+            else:
+                print(format_trace(e))
+                print()
+        if not args.follow:
+            if not entries:
+                print("(no kept traces)")
+            return 0
+        time.sleep(args.interval)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def cmd_metrics(args: argparse.Namespace) -> int:
+    url = args.url.rstrip("/") + "/metrics"
+    try:
+        text = _get(url).decode()
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"photon-tpu-obs: {url}: {exc}", file=sys.stderr)
+        return 1
+    for line in text.splitlines():
+        if not args.prefix:
+            print(line)
+            continue
+        if line.startswith("#"):
+            # Keep a TYPE/HELP header only when its metric matches.
+            parts = line.split()
+            if len(parts) >= 3 and parts[2].startswith(args.prefix):
+                print(line)
+        elif line.startswith(args.prefix):
+            print(line)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# slo
+# ---------------------------------------------------------------------------
+
+
+def _find_block(stats: dict, key: str) -> Optional[dict]:
+    """Depth-first search for the named block: the fleet ``/healthz``
+    nests engine stats per replica."""
+    if not isinstance(stats, dict):
+        return None
+    if isinstance(stats.get(key), dict):
+        return stats[key]
+    for v in stats.values():
+        found = _find_block(v, key) if isinstance(v, dict) else None
+        if found is not None:
+            return found
+    return None
+
+
+def cmd_slo(args: argparse.Namespace) -> int:
+    url = args.url.rstrip("/") + "/healthz"
+    try:
+        stats = _get_json(url)
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"photon-tpu-obs: {url}: {exc}", file=sys.stderr)
+        return 1
+    slo = _find_block(stats, "slo")
+    sink = _find_block(stats, "telemetry_sink")
+    if args.json:
+        print(json.dumps({"slo": slo, "telemetry_sink": sink}, indent=2))
+        return 0
+    if slo is None:
+        print("(no slo block in /healthz)")
+        return 1
+    print(f"overall state: {slo.get('state', '?')}")
+    for name, obj in (slo.get("objectives") or {}).items():
+        burns = "  ".join(
+            f"{w}={b:.2f}" if isinstance(b, (int, float)) else f"{w}=–"
+            for w, b in (obj.get("burn") or {}).items()
+        )
+        print(
+            f"  {name}: state={obj.get('state', '?')}"
+            f" target={obj.get('target')}"
+            f" events={obj.get('events')}  burn: {burns or '–'}"
+        )
+    if sink is not None:
+        print(
+            "telemetry sink: "
+            f"bytes_written={sink.get('bytes_written')}"
+            f" records_dropped={sink.get('records_dropped')}"
+            f" write_failures={sink.get('write_failures')}"
+            f" last_write_error={sink.get('last_write_error')!r}"
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon-tpu-obs",
+        description="Inspect a photon-tpu serving endpoint's traces, "
+        "metrics, and SLO state.",
+    )
+    p.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="serving endpoint base URL (default %(default)s)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("traces", help="dump kept flight-recorder traces")
+    t.add_argument("--limit", type=int, default=None,
+                   help="newest N traces only")
+    t.add_argument("--follow", action="store_true",
+                   help="poll and print traces as they are kept")
+    t.add_argument("--interval", type=float, default=2.0,
+                   help="poll interval for --follow (default %(default)s)")
+    t.add_argument("--json", action="store_true",
+                   help="one JSON entry per line instead of trees")
+    t.set_defaults(fn=cmd_traces)
+
+    m = sub.add_parser("metrics", help="dump the Prometheus text scrape")
+    m.add_argument("--prefix", default=None,
+                   help="only metrics whose name starts with this")
+    m.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser("slo", help="show SLO burn state from /healthz")
+    s.add_argument("--json", action="store_true",
+                   help="raw slo + telemetry_sink blocks as JSON")
+    s.set_defaults(fn=cmd_slo)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

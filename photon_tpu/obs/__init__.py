@@ -19,22 +19,34 @@ run's report) and ``finalize_run_report`` at exit.
 """
 
 from photon_tpu.obs.metrics import (  # noqa: F401
+    PROMETHEUS_CONTENT_TYPE,
     MetricsRegistry,
     registry,
+    render_prometheus,
     reset_registry,
 )
 from photon_tpu.obs.report import (  # noqa: F401
     TELEMETRY_SCHEMA,
     collect_run_records,
     finalize_run_report,
+    telemetry_sink_health,
     validate_record,
     write_run_report,
 )
+from photon_tpu.obs.slo import SLOTracker  # noqa: F401
 from photon_tpu.obs.trace import (  # noqa: F401
+    FlightRecorder,
     SpanRecord,
+    TraceContext,
+    attach_context,
     current_span_path,
+    extract_context,
+    flight_recorder,
     get_spans,
+    merge_trace_dumps,
+    mint_context,
     record_span,
+    reset_flight_recorder,
     reset_tracer,
     span,
     tracer,
@@ -50,6 +62,7 @@ def begin_run() -> None:
     from photon_tpu.utils.timed import Timed
 
     reset_tracer()
+    reset_flight_recorder()
     reset_registry()
     Timed.reset()
     default_cache().reset_stats()

@@ -15,12 +15,51 @@ the instrument's own lock, so concurrent stage threads can increment the
 same counter without losing updates (tests/test_telemetry.py hammers this).
 Values are plain Python numbers — publishing a device array here would
 force a host sync, so callers convert exactly once, at finalize.
+
+Naming convention (audited PR 14; new instruments MUST follow it):
+
+- Counters end in ``_total`` (``serve_requests_total``). A counter counts
+  events or monotonically-accumulated quantities; byte accumulators are
+  counters too (``re_store_upload_bytes_total``).
+- The unit is a suffix, and it is the LAST suffix before ``_total``:
+  seconds are ``_s`` (``serve_queue_wait_s``), bytes are ``_bytes``
+  (``host_rss_bytes``). ``_seconds`` and unit-then-qualifier orderings
+  (``model_staleness_s_hist``) are legacy; renamed instruments keep a
+  read-alias in ``CANONICAL_NAMES`` so old call sites and dashboards
+  resolve to the SAME instrument under the new name.
+- Serve-path instruments carry a ``replica`` label: fleet replicas stamp
+  it via ``set_default_labels(replica=<id>)`` at process start; the
+  frontend's own instruments get ``replica="frontend"`` filled in at
+  ``/metrics`` render time (``render_prometheus(extra_labels=...)``),
+  so one merged scrape never mixes two processes' series.
+
+``render_prometheus`` turns ``snapshot()`` dicts (ours or a fleet
+replica's, shipped over the scrape op) into Prometheus text exposition
+format v0.0.4: counters/gauges verbatim, histograms as summaries
+(``{quantile=...}`` + ``_sum``/``_count``).
 """
 
 from __future__ import annotations
 
+import re
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Legacy instrument name -> canonical name. Both spellings address the
+# SAME instrument (the registry canonicalizes on every lookup), and
+# snapshots/renders emit only the canonical name.
+CANONICAL_NAMES: Dict[str, str] = {
+    "re_entities_skipped": "re_entities_skipped_total",
+    "pipeline_wall_seconds": "pipeline_wall_s",
+    "pipeline_stage_busy_seconds": "pipeline_stage_busy_s",
+    "pipeline_stage_starved_seconds": "pipeline_stage_starved_s",
+    "pipeline_stage_backpressured_seconds": "pipeline_stage_backpressured_s",
+    "model_staleness_s_hist": "model_staleness_hist_s",
+}
+
+
+def canonical_name(name: str) -> str:
+    return CANONICAL_NAMES.get(name, name)
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -198,6 +237,7 @@ class MetricsRegistry:
             return dict(self._default_labels)
 
     def _get(self, cls, name: str, labels: Dict[str, object]):
+        name = canonical_name(name)
         if self._default_labels:
             labels = {**self._default_labels, **labels}
         key = (name, _label_key(labels))
@@ -226,6 +266,7 @@ class MetricsRegistry:
         """Lookup without creating (tests, bench readers). Default labels
         are merged the same way ``_get`` merges them, so an in-process
         reader addresses instruments by the labels IT passed at creation."""
+        name = canonical_name(name)
         if self._default_labels:
             labels = {**self._default_labels, **labels}
         with self._lock:
@@ -260,3 +301,112 @@ def registry() -> MetricsRegistry:
 
 def reset_registry() -> None:
     _REGISTRY.reset()
+
+
+# -- Prometheus text exposition -------------------------------------------
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_PROM_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
+_QUANTILES = (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99"))
+
+
+def prometheus_name(name: str) -> str:
+    """Canonicalize then sanitize to the Prometheus metric-name charset."""
+    name = _PROM_NAME_BAD.sub("_", canonical_name(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_label_value(value: object) -> str:
+    s = str(value)
+    return s.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _prom_labels(labels: Dict[str, object]) -> str:
+    if not labels:
+        return ""
+    parts = [
+        f'{_PROM_LABEL_BAD.sub("_", str(k))}="{_prom_label_value(v)}"'
+        for k, v in sorted(labels.items(), key=lambda kv: str(kv[0]))
+    ]
+    return "{" + ",".join(parts) + "}"
+
+
+def _prom_number(value) -> str:
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return "NaN"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(
+    snapshots: Iterable[dict],
+    extra_labels: Optional[Dict[str, str]] = None,
+) -> str:
+    """Snapshot dicts (``MetricsRegistry.snapshot()`` shape — local or
+    shipped from a fleet replica over the scrape op) -> Prometheus text
+    exposition format v0.0.4.
+
+    ``extra_labels`` FILL IN where absent (existing labels win): the
+    frontend stamps ``replica="frontend"`` on its own instruments this way
+    so the merged fleet scrape keeps every serve-path series disambiguated
+    by replica. Counters/gauges render verbatim; histograms render as
+    summaries (quantile series + ``_sum``/``_count``). Series are grouped
+    by name so each metric gets exactly one ``# TYPE`` header even when
+    several processes contribute."""
+    extra = {str(k): str(v) for k, v in (extra_labels or {}).items()}
+    grouped: "Dict[str, List[dict]]" = {}
+    order: List[str] = []
+    for snap in snapshots:
+        if not isinstance(snap, dict) or snap.get("record") != "metric":
+            continue
+        name = prometheus_name(str(snap.get("metric", "")))
+        if name not in grouped:
+            grouped[name] = []
+            order.append(name)
+        grouped[name].append(snap)
+    lines: List[str] = []
+    for name in order:
+        snaps = grouped[name]
+        kind = snaps[0].get("type", "gauge")
+        prom_type = {"counter": "counter", "histogram": "summary"}.get(
+            str(kind), "gauge"
+        )
+        lines.append(f"# TYPE {name} {prom_type}")
+        for snap in snaps:
+            labels = dict(snap.get("labels") or {})
+            for k, v in extra.items():
+                labels.setdefault(k, v)
+            if snap.get("type") == "histogram":
+                stats = snap.get("stats") or {}
+                for pkey, q in _QUANTILES:
+                    val = stats.get(pkey)
+                    if val is None:
+                        continue
+                    qlabels = dict(labels)
+                    qlabels["quantile"] = q
+                    lines.append(
+                        f"{name}{_prom_labels(qlabels)} {_prom_number(val)}"
+                    )
+                lines.append(
+                    f"{name}_sum{_prom_labels(labels)} "
+                    f"{_prom_number(stats.get('sum', 0.0))}"
+                )
+                lines.append(
+                    f"{name}_count{_prom_labels(labels)} "
+                    f"{_prom_number(stats.get('count', 0))}"
+                )
+            else:
+                value = snap.get("value")
+                if value is None:
+                    continue
+                lines.append(
+                    f"{name}{_prom_labels(labels)} {_prom_number(value)}"
+                )
+    return "\n".join(lines) + ("\n" if lines else "")

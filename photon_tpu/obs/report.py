@@ -312,6 +312,7 @@ def write_run_report(
     warning and a ``telemetry_write_failures_total`` count instead of
     crashing the driver after training already succeeded. The partial tmp
     file is removed either way."""
+    global _last_write_error
     if max_bytes is None:
         env = os.environ.get("PHOTON_TPU_TELEMETRY_MAX_BYTES")
         if env:
@@ -335,6 +336,15 @@ def write_run_report(
                 guard.check()  # ``enospc``/error rules for telemetry.write
                 f.writelines(lines)
             os.replace(tmp, path)
+            try:
+                from photon_tpu.obs.metrics import registry
+
+                registry().counter("telemetry_bytes_written_total").inc(
+                    sum(len(line) for line in lines)
+                )
+            except Exception:
+                pass
+            _last_write_error = None
         except OSError as exc:
             guard.record(exc)
             guard.cleanup(tmp)
@@ -348,6 +358,32 @@ def write_run_report(
                 "dropping run report %s (%d records): write failed: %s",
                 path, len(records), exc,
             )
+            _last_write_error = f"{type(exc).__name__}: {exc}"
+
+
+# Last run-report write failure (None after a successful write): the
+# human-readable tail of the sink-health story the counters can't tell.
+_last_write_error: Optional[str] = None
+
+
+def telemetry_sink_health() -> Dict[str, Any]:
+    """The ``/healthz`` telemetry-sink block: is the observability data
+    itself healthy — bytes landed, records shed under the byte budget,
+    write failures, and the most recent write error (telemetry sits at the
+    bottom of the degradation priority, so "serving is fine but telemetry
+    is dropping" must be visible SOMEWHERE other than the dropped data)."""
+    from photon_tpu.obs.metrics import registry
+
+    def _count(name: str) -> float:
+        inst = registry().find(name)
+        return float(inst.value) if inst is not None else 0.0
+
+    return dict(
+        bytes_written=_count("telemetry_bytes_written_total"),
+        records_dropped=_count("telemetry_records_dropped_total"),
+        write_failures=_count("telemetry_write_failures_total"),
+        last_write_error=_last_write_error,
+    )
 
 
 def finalize_run_report(

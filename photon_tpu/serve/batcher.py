@@ -67,6 +67,15 @@ class ScoreRequest:
     # Set by ServingEngine.submit from its ``tenant`` argument: rides along
     # so the feedback spool can apply per-tenant sampling fractions.
     tenant: Optional[str] = None
+    # Cross-process trace context (TraceContext.to_dict() shape), stamped
+    # by whichever frontend admitted the request: the engine hands it to
+    # downstream hops (fleet replicas) and to the feedback spool so a
+    # micro-generation can name the requests that fed it.
+    trace: Optional[dict] = None
+    # Set by the engine when this request's score was produced under a
+    # degraded path (breaker-open FE-only resolve, pin-eviction fallback):
+    # the flight recorder keeps such requests' span trees.
+    degraded: bool = False
 
 
 @dataclasses.dataclass

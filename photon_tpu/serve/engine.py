@@ -58,7 +58,9 @@ from photon_tpu.data.random_effect import bucket_dim
 from photon_tpu.estimators.game_transformer import GameTransformer
 from photon_tpu.models.game import GameModel
 from photon_tpu.obs.metrics import registry
-from photon_tpu.obs.trace import tracer
+from photon_tpu.obs.report import telemetry_sink_health
+from photon_tpu.obs.slo import SLOTracker
+from photon_tpu.obs.trace import flight_recorder, tracer
 from photon_tpu.serve.admission import (
     INTERACTIVE,
     AdmissionConfig,
@@ -200,6 +202,12 @@ class ServingEngine:
         # Feedback spool (streaming freshness loop): when attached, every
         # scored primary request is offered to the spool's label join.
         self._feedback = None
+        # SLO plane: availability + latency fed per completion, staleness
+        # sampled against the last primary-generation change. Lives on the
+        # engine (the one device-owning process); fleet replicas each run
+        # their own and the scrape merges them.
+        self.slo = SLOTracker()
+        self._last_model_update = time.time()
         self.batcher = MicroBatcher(
             self._score_batch,
             max_batch_size=self.max_batch,
@@ -337,7 +345,18 @@ class ServingEngine:
         entity_ids = {}
         for rt in store.entity_re_types:
             keys = [r.entity_ids.get(rt, -1) for r in requests]
-            entity_ids[rt] = self._resolve_guarded(store, rt, keys)
+            slots, batch_degraded = self._resolve_guarded(store, rt, keys)
+            entity_ids[rt] = slots
+            if batch_degraded:
+                # Breaker-open / failed resolve: every request in this
+                # batch scored FE-only for this type — flight-recorder bait.
+                for r in requests:
+                    r.degraded = True
+            elif self._partition is not None and self._partition.applies_to(rt):
+                # Foreign (non-owned) entities degrade FE-only per request.
+                for r, key in zip(requests, keys):
+                    if key != -1 and not self._partition.owns(key):
+                        r.degraded = True
         return GameBatch(
             label=np.zeros(n, np.float32),
             offset=np.asarray([r.offset for r in requests], np.float32),
@@ -356,18 +375,20 @@ class ServingEngine:
 
     def _resolve_guarded(
         self, store: HotColdEntityStore, re_type: str, keys: List
-    ) -> np.ndarray:
+    ) -> tuple:
         """``store.resolve`` behind the RE type's circuit breaker. Open
         breaker (or a failing resolve) degrades THIS batch's type to all
         -1 slots — cold-start semantics, so the random effect contributes 0
-        and scoring proceeds FE-only on already-compiled shapes."""
+        and scoring proceeds FE-only on already-compiled shapes. Returns
+        ``(slots, degraded)`` so the assembler can mark the requests for
+        the flight recorder."""
         breaker = self._breaker(re_type)
         reg = registry()
         if breaker.open:
             reg.counter("serve_requests_degraded_total", re_type=re_type).inc(
                 len(keys)
             )
-            return np.full(len(keys), -1, np.int32)
+            return np.full(len(keys), -1, np.int32), True
         try:
             slots = store.resolve(re_type, keys)
         except Exception as exc:  # noqa: BLE001 — degrade, never crash
@@ -388,9 +409,9 @@ class ServingEngine:
             reg.counter("serve_requests_degraded_total", re_type=re_type).inc(
                 len(keys)
             )
-            return np.full(len(keys), -1, np.int32)
+            return np.full(len(keys), -1, np.int32), True
         breaker.record_success()
-        return slots
+        return slots, False
 
     # -- the batcher's score_fn --------------------------------------------
 
@@ -446,6 +467,7 @@ class ServingEngine:
                         "scoring on primary %r", key, self._primary,
                     )
                     key = self._primary
+                    r.degraded = True
                 # Record the generation that ACTUALLY scores this request —
                 # the front ends report req.model_version, and the caller
                 # must never see a pin label a score it didn't produce.
@@ -483,6 +505,7 @@ class ServingEngine:
                     score=float(s),
                     model_version=r.model_version,
                     tenant=getattr(r, "tenant", None),
+                    trace=getattr(r, "trace", None),
                 )
         except Exception as exc:  # noqa: BLE001 — feedback never hurts callers
             registry().counter("feedback_errors_total").inc()
@@ -567,11 +590,21 @@ class ServingEngine:
         )
         t0 = time.monotonic()
         fut = self.batcher.submit(request, deadline_s, priority=priority)
-        fut.add_done_callback(
-            lambda f: self.admission.observe_latency(
-                tenant, time.monotonic() - t0
-            )
-        )
+
+        def _observe_done(f):
+            dt = time.monotonic() - t0
+            self.admission.observe_latency(tenant, dt)
+            # SLO feed: availability (admitted requests that errored) and
+            # latency for successes; staleness sampled per completion
+            # against the last primary-generation change. All host math.
+            try:
+                ok = f.exception() is None
+            except Exception:  # noqa: BLE001 — cancelled futures count bad
+                ok = False
+            self.slo.record_request(ok, dt if ok else None)
+            self.slo.record_staleness(time.time() - self._last_model_update)
+
+        fut.add_done_callback(_observe_done)
         return fut
 
     def score(
@@ -850,6 +883,7 @@ class ServingEngine:
             self._primary = key
             if self._shadow == key:
                 self._shadow = None
+            self._last_model_update = time.time()  # SLO staleness clock
         registry().counter("serve_promotions_total").inc()
         logger.info("serving: promoted %r (parent %r)", key, parent)
         return dict(model_version=key, parent=parent)
@@ -943,7 +977,22 @@ class ServingEngine:
             feedback=(
                 self._feedback.stats() if self._feedback is not None else None
             ),
+            slo=self._slo_block(),
+            telemetry_sink=telemetry_sink_health(),
+            flight_recorder=flight_recorder().stats(),
         )
+
+    def _slo_block(self) -> Dict:
+        """The ``/healthz`` SLO block; also the flush point that mirrors
+        burn/state into gauges so the ``/metrics`` scrape carries them."""
+        self.slo.record_staleness(time.time() - self._last_model_update)
+        try:
+            self.slo.publish_metrics()
+        except Exception:  # noqa: BLE001 — stats must never fail on obs
+            pass
+        snap = self.slo.snapshot()
+        snap["model_staleness_now_s"] = time.time() - self._last_model_update
+        return snap
 
     def close(self, drain: bool = True) -> None:
         self.batcher.close(drain=drain)

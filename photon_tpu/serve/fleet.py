@@ -47,7 +47,14 @@ from concurrent.futures import Future
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence
 
-from photon_tpu.obs.metrics import registry
+from photon_tpu.obs.metrics import registry, render_prometheus
+from photon_tpu.obs.trace import (
+    TraceContext,
+    flight_recorder,
+    merge_trace_dumps,
+    new_span_id,
+    tracer,
+)
 from photon_tpu.serve.admission import (
     INTERACTIVE,
     AdmissionConfig,
@@ -57,6 +64,7 @@ from photon_tpu.serve.batcher import BackpressureError
 from photon_tpu.serve.frontend import (
     ScorerClient,
     ScorerServer,
+    _stamp_labels,
     make_http_handler,
 )
 from photon_tpu.serve.routing import HashRing, route_key
@@ -151,17 +159,9 @@ class ReplicaScorerServer(ScorerServer):
             except Exception as exc:  # noqa: BLE001 — per-request failure
                 out.put(self._error_payload(rid, exc))
             return
-        if op == "metrics":
-            # Per-replica counter/gauge scrape (every instrument carries the
-            # ``replica`` default label): how the fleet soak proves disjoint
-            # hot sets from hit/miss rates without an HTTP port per replica.
-            try:
-                from photon_tpu.obs.metrics import registry
-
-                out.put(dict(id=rid, ok=True, result=registry().snapshot()))
-            except Exception as exc:  # noqa: BLE001 — per-request failure
-                out.put(self._error_payload(rid, exc))
-            return
+        # "metrics" (the per-replica counter/gauge scrape, every instrument
+        # carrying the ``replica`` default label) and "traces" (the
+        # flight-recorder ring) come from the ScorerServer base.
         super()._dispatch(msg, out)
 
 
@@ -380,6 +380,7 @@ class FleetRouter:
         tenant: Optional[str],
         priority: str = INTERACTIVE,
         model_version: Optional[str] = None,
+        trace: Optional[dict] = None,
     ) -> Future:
         # Fleet-global admission: ONE ledger charge per request, before any
         # replica sees it — identical shed semantics at any fleet size.
@@ -397,18 +398,20 @@ class FleetRouter:
         if not cands:
             raise BackpressureError("no live scorer replicas")
         dst: Future = Future()
-        self._try(raw_request, tenant, priority, model_version, cands, dst)
+        self._try(
+            raw_request, tenant, priority, model_version, trace, cands, dst
+        )
         return dst
 
     def _try(
-        self, raw_request, tenant, priority, model_version,
+        self, raw_request, tenant, priority, model_version, trace,
         cands: List[str], dst: Future,
     ) -> None:
         replica_id, rest = cands[0], cands[1:]
         client = self.client(replica_id)
         if client is None:
             self._advance(
-                raw_request, tenant, priority, model_version,
+                raw_request, tenant, priority, model_version, trace,
                 replica_id, rest, dst,
                 ConnectionError(f"replica {replica_id} not attached"),
             )
@@ -417,13 +420,13 @@ class FleetRouter:
         self.ledger.begin(replica_id)
         try:
             src = client.submit_score(
-                raw_request, tenant, priority, model_version
+                raw_request, tenant, priority, model_version, trace=trace
             )
         except ConnectionError as exc:
             self.ledger.end(replica_id)
             self._on_conn_lost(replica_id)
             self._advance(
-                raw_request, tenant, priority, model_version,
+                raw_request, tenant, priority, model_version, trace,
                 replica_id, rest, dst, exc,
             )
             return
@@ -436,7 +439,7 @@ class FleetRouter:
                 # read-only → safe to replay on the next live candidate.
                 self._on_conn_lost(replica_id)
                 self._advance(
-                    raw_request, tenant, priority, model_version,
+                    raw_request, tenant, priority, model_version, trace,
                     replica_id, rest, dst, exc,
                 )
             elif exc is not None:
@@ -455,7 +458,7 @@ class FleetRouter:
         src.add_done_callback(_done)
 
     def _advance(
-        self, raw_request, tenant, priority, model_version,
+        self, raw_request, tenant, priority, model_version, trace,
         failed_id: str, rest: List[str], dst: Future,
         exc: BaseException,
     ) -> None:
@@ -466,7 +469,9 @@ class FleetRouter:
                 if self._state.get(m) == LIVE and m in self._clients
             ]
         if nxt:
-            self._try(raw_request, tenant, priority, model_version, nxt, dst)
+            self._try(
+                raw_request, tenant, priority, model_version, trace, nxt, dst
+            )
         else:
             dst.set_exception(exc)
 
@@ -518,19 +523,47 @@ class FleetRouter:
                 out[replica_id] = dict(error=str(exc))
         return out
 
-    def replica_metrics(self, timeout_s: float = 30.0) -> Dict[str, list]:
-        """Per-replica metrics scrape: each live member's full
-        counter/gauge snapshot (labelled ``replica=<id>``)."""
-        out: Dict[str, list] = {}
+    def replica_metrics(self, timeout_s: float = 30.0) -> Dict[str, dict]:
+        """Per-replica metrics scrape: ``{replica: {"ok": True, "metrics":
+        [snapshot records]}}`` for members that answered, ``{"ok": False,
+        "error": str}`` for members that died mid-scrape. A partial fleet
+        scrape stays LABELED as partial — the merged ``/metrics`` render
+        marks the missing member instead of silently presenting a smaller
+        fleet as the whole one."""
+        out: Dict[str, dict] = {}
+        for replica_id in self.live_members():
+            client = self.client(replica_id)
+            if client is None:
+                out[replica_id] = dict(ok=False, error="not attached")
+                continue
+            try:
+                out[replica_id] = dict(
+                    ok=True,
+                    metrics=client.call("metrics", timeout_s=timeout_s) or [],
+                )
+            except Exception as exc:  # noqa: BLE001 — per-member failure
+                out[replica_id] = dict(ok=False, error=str(exc))
+        return out
+
+    def replica_traces(
+        self, limit: Optional[int] = None, timeout_s: float = 30.0,
+    ) -> List[dict]:
+        """Every live member's kept flight-recorder trees (concatenated;
+        callers merge by trace id). A member failing the scrape contributes
+        nothing — trace dumps are diagnostics, not bookkeeping."""
+        entries: List[dict] = []
         for replica_id in self.live_members():
             client = self.client(replica_id)
             if client is None:
                 continue
             try:
-                out[replica_id] = client.call("metrics", timeout_s=timeout_s)
+                entries.extend(
+                    client.call("traces", timeout_s=timeout_s, limit=limit)
+                    or []
+                )
             except Exception:  # noqa: BLE001 — per-member failure
-                out[replica_id] = []
-        return out
+                pass
+        return entries
 
     def fleet_snapshot(self) -> dict:
         """The ``/healthz`` ``fleet`` block: ring version, per-replica
@@ -558,14 +591,51 @@ class FleetBackend:
     def submit(
         self, raw_request: dict, tenant: Optional[str], priority: str,
         model_version: Optional[str] = None,
+        trace: Optional[dict] = None,
     ) -> Future:
-        return self.router.submit(raw_request, tenant, priority, model_version)
+        return self.router.submit(
+            raw_request, tenant, priority, model_version, trace=trace
+        )
 
     def stats(self) -> dict:
         return dict(
             fleet=self.router.fleet_snapshot(),
             replicas=self.router.replica_stats(),
         )
+
+    def metrics_snapshots(self) -> List[dict]:
+        """Fleet-merged snapshot records: this process's instruments
+        (``replica="frontend"``) plus every replica's (their own labels).
+        A replica that failed the scrape shows up as
+        ``fleet_scrape_failed{replica=...} 1`` — visible, not missing."""
+        snaps = [
+            _stamp_labels(s, replica="frontend")
+            for s in registry().snapshot()
+        ]
+        for replica_id, res in self.router.replica_metrics().items():
+            if res.get("ok"):
+                snaps.extend(
+                    _stamp_labels(s, replica=replica_id)
+                    for s in res.get("metrics") or []
+                )
+            else:
+                snaps.append(dict(
+                    record="metric", metric="fleet_scrape_failed",
+                    type="gauge", labels={"replica": str(replica_id)},
+                    value=1, stats=None,
+                ))
+        return snaps
+
+    def metrics_text(self) -> str:
+        return render_prometheus(self.metrics_snapshots())
+
+    def traces(self, limit: Optional[int] = None) -> List[dict]:
+        """One merged entry per trace id across the frontend process and
+        every replica — a routed request's http/relay/replica hops
+        reassemble here."""
+        entries = list(flight_recorder().traces(limit=limit))
+        entries.extend(self.router.replica_traces(limit=limit))
+        return merge_trace_dumps(entries)
 
     def reload(self, body: dict) -> dict:
         out: Dict[str, dict] = {}
@@ -622,6 +692,89 @@ class FleetBackend:
             joined += chunk_joined
             dropped += max(0, len(chunk) - chunk_joined)
         return {"joined": joined, "dropped": dropped}
+
+
+class FleetRelayScorerServer(ScorerServer):
+    """The scorer-socket server for a FLEET front end: lets
+    :class:`~photon_tpu.serve.frontend.ServingFrontend`'s forked HTTP
+    workers (which speak the ordinary scorer IPC) sit in front of a whole
+    replica fleet instead of one local engine. Each ``score`` routes
+    through the :class:`FleetBackend`'s ring; ``metrics``/``traces``
+    answer with the fleet-wide merge, so a worker's ``/metrics`` and
+    ``/v1/traces`` see every replica.
+
+    Trace-wise this is the middle hop: the worker's http span is the
+    parent, this relay records ``relay/route`` under it, and the replica
+    that scores records its ``scorer/score`` under the relay span — three
+    processes, one tree."""
+
+    def __init__(self, backend: FleetBackend, socket_path: str):
+        super().__init__(engine=None, socket_path=socket_path)
+        self.backend = backend
+
+    def _op_score(self, rid, msg: dict, out) -> None:
+        raw = msg.get("request") or {}
+        ctx = TraceContext.from_dict(msg.get("trace"))
+        sid: Optional[str] = None
+        down: Optional[dict] = None
+        if ctx is not None and ctx.sampled:
+            sid = new_span_id()
+            down = ctx.child(sid).to_dict()
+        t0 = time.monotonic()
+        fut = self.backend.submit(
+            raw,
+            msg.get("tenant"),
+            msg.get("priority") or INTERACTIVE,
+            msg.get("modelVersion"),
+            trace=down,
+        )
+
+        def _done(f: Future) -> None:
+            exc = f.exception()
+            if sid is not None:
+                try:
+                    dt = time.monotonic() - t0
+                    tracer().record(
+                        "relay/route", dt, parent="",
+                        context=ctx, span_id=sid,
+                    )
+                    flight_recorder().finish(
+                        ctx.trace_id, dt,
+                        error=None if exc is None else str(exc),
+                        forced=ctx.forced,
+                    )
+                except Exception:
+                    pass  # telemetry must never fail the response
+            if exc is not None:
+                out.put(self._error_payload(rid, exc))
+            else:
+                out.put(dict(id=rid, ok=True, result=f.result()))
+
+        fut.add_done_callback(_done)
+
+    def _op_stats(self) -> dict:
+        return self.backend.stats()
+
+    def _op_feedback(self, msg: dict) -> dict:
+        return self.backend.feedback(msg.get("body") or {})
+
+    def _op_reload(self, rid, msg: dict, out) -> None:
+        try:
+            out.put(dict(
+                id=rid, ok=True,
+                result=self.backend.reload(dict(
+                    modelDir=msg.get("modelDir"),
+                    modelVersion=msg.get("modelVersion"),
+                )),
+            ))
+        except Exception as exc:  # noqa: BLE001 — per-request failure
+            out.put(self._error_payload(rid, exc))
+
+    def _op_metrics(self, msg: dict) -> List[dict]:
+        return self.backend.metrics_snapshots()
+
+    def _op_traces(self, msg: dict) -> List[dict]:
+        return self.backend.traces(limit=msg.get("limit"))
 
 
 class FleetHTTPFrontend:

@@ -54,7 +54,21 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
+from urllib.parse import parse_qs
 
+from photon_tpu.obs.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    registry,
+    render_prometheus,
+)
+from photon_tpu.obs.trace import (
+    TraceContext,
+    flight_recorder,
+    merge_trace_dumps,
+    mint_context,
+    new_span_id,
+    tracer,
+)
 from photon_tpu.serve.admission import INTERACTIVE, PRIORITIES, QuotaExceededError
 from photon_tpu.serve.batcher import (
     BackpressureError,
@@ -189,6 +203,16 @@ def apply_feedback(engine, body: dict) -> dict:
         else:
             dropped += 1
     return {"joined": joined, "dropped": dropped}
+
+
+def _stamp_labels(snap: dict, **labels) -> dict:
+    """Fill ``labels`` into a metric snapshot record where absent (existing
+    labels win) — how a merged fleet scrape tells the frontend's instruments
+    from each replica's without rewriting anything the producer stamped."""
+    merged = dict(snap.get("labels") or {})
+    for k, v in labels.items():
+        merged.setdefault(str(k), str(v))
+    return dict(snap, labels=merged)
 
 
 # ---------------------------------------------------------------------------
@@ -326,7 +350,7 @@ class ScorerServer:
             if op == "score":
                 self._op_score(rid, msg, out)
             elif op == "stats":
-                out.put(dict(id=rid, ok=True, result=self.engine.stats()))
+                out.put(dict(id=rid, ok=True, result=self._op_stats()))
             elif op == "reload":
                 # Off-thread: a reload warms a whole model generation;
                 # this connection's scores must keep flowing meanwhile.
@@ -336,9 +360,12 @@ class ScorerServer:
                 ).start()
             elif op == "feedback":
                 out.put(dict(
-                    id=rid, ok=True,
-                    result=apply_feedback(self.engine, msg.get("body") or {}),
+                    id=rid, ok=True, result=self._op_feedback(msg),
                 ))
+            elif op == "metrics":
+                out.put(dict(id=rid, ok=True, result=self._op_metrics(msg)))
+            elif op == "traces":
+                out.put(dict(id=rid, ok=True, result=self._op_traces(msg)))
             elif op == "ping":
                 out.put(dict(id=rid, ok=True, result="pong"))
             else:
@@ -348,6 +375,15 @@ class ScorerServer:
 
     def _op_score(self, rid, msg: dict, out: "queue.Queue") -> None:
         req = request_from_json(msg.get("request") or {})
+        ctx = TraceContext.from_dict(msg.get("trace"))
+        sid: Optional[str] = None
+        if ctx is not None and ctx.sampled:
+            # Pre-mint this hop's span id so downstream consumers (fleet
+            # replicas, the feedback spool) can parent on it before the
+            # span itself completes on the done-callback below.
+            sid = new_span_id()
+            req.trace = ctx.child(sid).to_dict()
+        t0 = time.monotonic()
         fut = self.engine.submit(
             req,
             tenant=msg.get("tenant"),
@@ -357,6 +393,21 @@ class ScorerServer:
 
         def _done(f: Future) -> None:
             exc = f.exception()
+            if sid is not None:
+                try:
+                    dt = time.monotonic() - t0
+                    tracer().record(
+                        "scorer/score", dt, parent="",
+                        context=ctx, span_id=sid,
+                    )
+                    flight_recorder().finish(
+                        ctx.trace_id, dt,
+                        error=None if exc is None else str(exc),
+                        degraded=bool(getattr(req, "degraded", False)),
+                        forced=ctx.forced,
+                    )
+                except Exception:
+                    pass  # telemetry must never fail the response
             if exc is not None:
                 out.put(self._error_payload(rid, exc))
             else:
@@ -373,6 +424,23 @@ class ScorerServer:
                 ))
 
         fut.add_done_callback(_done)
+
+    def _op_stats(self) -> dict:
+        return self.engine.stats()
+
+    def _op_feedback(self, msg: dict) -> dict:
+        return apply_feedback(self.engine, msg.get("body") or {})
+
+    def _op_metrics(self, msg: dict) -> List[dict]:
+        """Registry snapshot for the worker-side ``/metrics`` merge.
+        Subclasses that front a whole fleet override this to return the
+        fleet-wide labeled merge."""
+        return registry().snapshot()
+
+    def _op_traces(self, msg: dict) -> List[dict]:
+        """This process's kept flight-recorder trees; subclasses fronting a
+        fleet override to merge the replicas' rings in."""
+        return flight_recorder().traces(limit=msg.get("limit"))
 
     def _op_reload(self, rid, msg: dict, out: "queue.Queue") -> None:
         try:
@@ -509,10 +577,11 @@ class ScorerClient:
         tenant: Optional[str] = None,
         priority: str = INTERACTIVE,
         model_version: Optional[str] = None,
+        trace: Optional[dict] = None,
     ) -> Future:
         return self.request(
             "score", request=raw_request, tenant=tenant, priority=priority,
-            modelVersion=model_version,
+            modelVersion=model_version, trace=trace,
         )
 
     def call(self, op: str, timeout_s: float = 30.0, **payload):
@@ -547,8 +616,15 @@ class LocalBackend:
     def submit(
         self, raw_request: dict, tenant: Optional[str], priority: str,
         model_version: Optional[str] = None,
+        trace: Optional[dict] = None,
     ) -> Future:
         req = request_from_json(raw_request)
+        ctx = TraceContext.from_dict(trace)
+        sid: Optional[str] = None
+        if ctx is not None and ctx.sampled:
+            sid = new_span_id()
+            req.trace = ctx.child(sid).to_dict()
+        t0 = time.monotonic()
         src = self.engine.submit(
             req, tenant=tenant, priority=priority,
             model_version=model_version,
@@ -557,6 +633,18 @@ class LocalBackend:
 
         def _done(f: Future) -> None:
             exc = f.exception()
+            # The HTTP handler owns the flight-recorder finish (it also
+            # times the response write); it reads the degraded flag off
+            # the future because the request object never crosses back.
+            dst._photon_degraded = bool(getattr(req, "degraded", False))
+            if sid is not None:
+                try:
+                    tracer().record(
+                        "engine/score", time.monotonic() - t0,
+                        parent="", context=ctx, span_id=sid,
+                    )
+                except Exception:
+                    pass
             if exc is not None:
                 dst.set_exception(exc)
             else:
@@ -574,6 +662,14 @@ class LocalBackend:
 
     def stats(self) -> dict:
         return self.engine.stats()
+
+    def metrics_text(self) -> str:
+        return render_prometheus(
+            registry().snapshot(), extra_labels={"replica": "frontend"}
+        )
+
+    def traces(self, limit: Optional[int] = None) -> List[dict]:
+        return merge_trace_dumps(flight_recorder().traces(limit=limit))
 
     def reload(self, body: dict) -> dict:
         from photon_tpu.io.model_io import load_game_model
@@ -606,9 +702,10 @@ class RemoteBackend:
     def submit(
         self, raw_request: dict, tenant: Optional[str], priority: str,
         model_version: Optional[str] = None,
+        trace: Optional[dict] = None,
     ) -> Future:
         return self.client.submit_score(
-            raw_request, tenant, priority, model_version
+            raw_request, tenant, priority, model_version, trace=trace
         )
 
     def stats(self) -> dict:
@@ -616,6 +713,34 @@ class RemoteBackend:
         stats["worker"] = self.worker_index
         stats["workerPid"] = os.getpid()
         return stats
+
+    def metrics_text(self) -> str:
+        """Fleet-merged Prometheus text: the scorer's instruments (labeled
+        ``replica="scorer"`` unless a producer already stamped a replica —
+        fleet relays return per-replica labels) plus this worker's own."""
+        remote: List[dict] = []
+        try:
+            remote = self.client.call("metrics", timeout_s=30.0) or []
+        except Exception:
+            registry().counter("frontend_scorer_scrape_errors_total").inc()
+        snaps = [
+            _stamp_labels(s, replica=f"worker{self.worker_index}")
+            for s in registry().snapshot()
+        ]
+        snaps.extend(_stamp_labels(s, replica="scorer") for s in remote)
+        return render_prometheus(snaps)
+
+    def traces(self, limit: Optional[int] = None) -> List[dict]:
+        """Kept traces merged by trace id across this worker and the
+        scorer (and, behind a fleet relay, every replica) — one request's
+        spans reassemble into one entry regardless of which process kept
+        which hop."""
+        local = flight_recorder().traces(limit=limit)
+        try:
+            remote = self.client.call("traces", timeout_s=30.0, limit=limit)
+        except Exception:
+            remote = []
+        return merge_trace_dumps(local + (remote or []))
 
     def reload(self, body: dict) -> dict:
         # A reload builds + warms a whole generation; give it real time.
@@ -680,11 +805,38 @@ def make_http_handler(backend):
                 version = obj.get("modelVersion", version)
             return version
 
+        def _query_int(self, key: str) -> Optional[int]:
+            if "?" not in self.path:
+                return None
+            vals = parse_qs(self.path.split("?", 1)[1]).get(key)
+            try:
+                return int(vals[0]) if vals else None
+            except (TypeError, ValueError):
+                return None
+
         def do_GET(self):
-            if self.path == "/healthz":
-                self._reply_json(200, backend.stats())
-            else:
-                self._reply_json(404, {"error": f"no route {self.path}"})
+            try:
+                route = self.path.split("?", 1)[0]
+                if route == "/healthz":
+                    self._reply_json(200, backend.stats())
+                elif route == "/metrics":
+                    self._reply(
+                        200, backend.metrics_text().encode(),
+                        ctype=PROMETHEUS_CONTENT_TYPE,
+                    )
+                elif route == "/v1/traces":
+                    self._reply_json(200, {
+                        "traces": backend.traces(
+                            limit=self._query_int("limit")
+                        ),
+                    })
+                else:
+                    self._reply_json(404, {"error": f"no route {self.path}"})
+            except Exception as exc:  # noqa: BLE001 — classified below
+                code, kind = classify_exception(exc)
+                if code == 500:
+                    logger.exception("request failed")
+                self._reply_json(code, {"error": str(exc), "kind": kind})
 
         def do_POST(self):
             try:
@@ -712,26 +864,78 @@ def make_http_handler(backend):
                     payload["tenant"] = tenant
                 self._reply_json(code, payload)
 
+        def _trace_context(self) -> TraceContext:
+            """Adopt the client's ``traceparent`` (arrives forced — an
+            explicit header is a request to SEE the trace) or mint a fresh
+            tail-sampled root context."""
+            ctx = TraceContext.from_traceparent(self.headers.get("traceparent"))
+            return ctx if ctx is not None else mint_context()
+
         def _score_one(self):
             obj = json.loads(self._body())
             tenant, priority = self._tenant_priority(obj)
-            res = backend.submit(
-                obj, tenant, priority, self._model_version(obj)
-            ).result(backend.result_timeout_s)
-            self._reply_json(200, res)
+            ctx = self._trace_context()
+            sid = new_span_id()
+            t0 = time.monotonic()
+            error: Optional[str] = None
+            fut: Optional[Future] = None
+            try:
+                fut = backend.submit(
+                    obj, tenant, priority, self._model_version(obj),
+                    trace=ctx.child(sid).to_dict(),
+                )
+                res = fut.result(backend.result_timeout_s)
+                self._reply_json(200, res)
+            except Exception as exc:
+                error = str(exc)
+                raise
+            finally:
+                dt = time.monotonic() - t0
+                try:
+                    tracer().record(
+                        "http/v1/score", dt, parent="",
+                        context=ctx, span_id=sid,
+                    )
+                    flight_recorder().finish(
+                        ctx.trace_id, dt, error=error,
+                        degraded=bool(getattr(fut, "_photon_degraded", False)),
+                        forced=ctx.forced,
+                    )
+                except Exception:
+                    pass  # telemetry must never fail the response
 
         def _score_jsonl(self):
             tenant, priority = self._tenant_priority()
             version = self._model_version()
-            out = score_jsonl(
-                self._body(),
-                lambda obj: backend.submit(
-                    obj, tenant, priority, obj.get("modelVersion", version)
-                ),
-                result_timeout_s=backend.result_timeout_s,
-            )
-            payload = "".join(json.dumps(o) + "\n" for o in out).encode()
-            self._reply(200, payload, ctype="application/jsonl")
+            ctx = self._trace_context()
+            sid = new_span_id()
+            down = ctx.child(sid).to_dict()
+            t0 = time.monotonic()
+            try:
+                out = score_jsonl(
+                    self._body(),
+                    lambda obj: backend.submit(
+                        obj, tenant, priority,
+                        obj.get("modelVersion", version), trace=down,
+                    ),
+                    result_timeout_s=backend.result_timeout_s,
+                )
+                payload = "".join(json.dumps(o) + "\n" for o in out).encode()
+                self._reply(200, payload, ctype="application/jsonl")
+            finally:
+                dt = time.monotonic() - t0
+                try:
+                    tracer().record(
+                        "http/v1/score-batch", dt, parent="",
+                        context=ctx, span_id=sid,
+                    )
+                    # Per-line failures answer in the body, so the batch
+                    # itself finishes clean; a forced/slow batch still keeps.
+                    flight_recorder().finish(
+                        ctx.trace_id, dt, forced=ctx.forced
+                    )
+                except Exception:
+                    pass
 
     return Handler
 

@@ -233,9 +233,13 @@ class FeedbackSpool:
         model_version: Optional[str] = None,
         tenant: Optional[str] = None,
         ts: Optional[float] = None,
+        trace: Optional[dict] = None,
     ) -> bool:
         """Buffer one scored request for its label. Returns True when the
-        request was retained (sampled in and buffered)."""
+        request was retained (sampled in and buffered). ``trace`` is the
+        request's cross-process trace context (``TraceContext.to_dict()``
+        shape): stamped onto the record so the streaming updater's
+        micro-generations can name the requests that fed them."""
         from photon_tpu.obs.metrics import registry
 
         if uid is None:
@@ -267,6 +271,11 @@ class FeedbackSpool:
                 "score": float(score),
                 "modelVersion": model_version,
             }
+            if trace is not None:
+                rec["trace"] = {
+                    "traceId": trace.get("traceId"),
+                    "parentSpanId": trace.get("parentSpanId"),
+                }
             self._pending[str(uid)] = (now, rec)
             self._evict_pending_locked(now)
         return True

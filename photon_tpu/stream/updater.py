@@ -395,6 +395,20 @@ class StreamingUpdater:
             stream_info[_PER_SPOOL_KEY] = new_cursors
         if oldest_label_ts is not None:
             stream_info["oldestLabelTs"] = oldest_label_ts
+        # Trace linkage: the request traces that fed this micro-generation
+        # (spool records carry the serve-side context). Bounded sample —
+        # enough to jump from a published generation back into the flight
+        # recorder / request logs, without growing manifests unboundedly.
+        trace_ids = []
+        seen_tids = set()
+        for r in records:
+            tid = (r.get("trace") or {}).get("traceId")
+            if tid and tid not in seen_tids:
+                seen_tids.add(tid)
+                trace_ids.append(tid)
+        if trace_ids:
+            stream_info["traceCount"] = len(trace_ids)
+            stream_info["traceIds"] = trace_ids[:32]
 
         result = incremental_update(
             cfg.publish_root,

@@ -198,7 +198,7 @@ class PipelineStats:
         from photon_tpu.obs.metrics import registry
 
         reg = registry()
-        reg.gauge("pipeline_wall_seconds", label=label).set(self.wall_s)
+        reg.gauge("pipeline_wall_s", label=label).set(self.wall_s)
         reg.gauge("pipeline_overlapped", label=label).set(int(self.overlapped))
         busy = sum(s.busy_s for s in self.stages)
         reg.gauge("pipeline_overlap_factor", label=label).set(
@@ -206,9 +206,9 @@ class PipelineStats:
         )
         for s in self.stages:
             kw = dict(label=label, stage=s.name)
-            reg.gauge("pipeline_stage_busy_seconds", **kw).set(s.busy_s)
-            reg.gauge("pipeline_stage_starved_seconds", **kw).set(s.wait_in_s)
-            reg.gauge("pipeline_stage_backpressured_seconds", **kw).set(
+            reg.gauge("pipeline_stage_busy_s", **kw).set(s.busy_s)
+            reg.gauge("pipeline_stage_starved_s", **kw).set(s.wait_in_s)
+            reg.gauge("pipeline_stage_backpressured_s", **kw).set(
                 s.wait_out_s
             )
             reg.gauge("pipeline_stage_occupancy", **kw).set(s.occupancy)

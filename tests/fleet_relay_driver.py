@@ -1,0 +1,59 @@
+"""Subprocess driver for the cross-process trace acceptance test.
+
+Builds the full three-hop deployment the observability plane is for:
+
+    forked HTTP workers (ServingFrontend, never import jax)
+        → FleetRelayScorerServer (this process, routes on the ring)
+            → 3 ScorerFleet replicas (subprocesses, own the engines)
+
+Fork discipline matters here exactly as in production: the workers fork
+FIRST, before anything heavy is imported, then this process builds the
+fleet. Prints one JSON ready banner ``{"ready": true, "port": N}`` on
+stdout and serves until stdin closes (the parent test's teardown).
+
+Not a test module — pytest only collects ``test_*.py``.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    model_dir, artifacts_root, workdir = sys.argv[1:4]
+
+    from photon_tpu.serve.frontend import ServingFrontend
+
+    fe = ServingFrontend("127.0.0.1", 0, num_workers=2)
+    fe.fork_workers()
+
+    from photon_tpu.serve.fleet import (
+        FleetBackend,
+        FleetRelayScorerServer,
+        ScorerFleet,
+    )
+
+    fleet = ScorerFleet(
+        model_dir, workdir, artifacts_dir=artifacts_root,
+        route_re_type="userId", hot_bytes=1,
+        max_batch_size=8, max_delay_ms=1.0,
+    )
+    try:
+        fleet.start(["r0", "r1", "r2"])
+        backend = FleetBackend(fleet.router)
+        relay = FleetRelayScorerServer(backend, fe.scorer_path)
+        relay.start()
+        fe.scorer = relay  # fe.shutdown() closes it after the workers drain
+        print(
+            json.dumps({"ready": True, "port": fe.port, "pid": os.getpid()}),
+            flush=True,
+        )
+        sys.stdin.readline()  # parent closes stdin to stop us
+    finally:
+        fe.shutdown()
+        fleet.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
