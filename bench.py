@@ -1652,6 +1652,19 @@ def run_obs_overhead_ab(n_requests: int = 4000, d: int = 32, E: int = 512):
                            queue_cap=n_requests),
     )
     backend = LocalBackend(engine)
+    # The PR 15 bar: the p99 ratio must hold WITH the OTLP exporter
+    # live — every traced span also flows through the export queue to a
+    # real (mock) collector during the measured phase.
+    from photon_tpu.obs.export import (
+        MockCollector,
+        OTLPExporter,
+        install_exporter,
+        uninstall_exporter,
+    )
+
+    collector = MockCollector()
+    exporter = install_exporter(OTLPExporter(collector.endpoint))
+    otlp_health = None
     try:
         # Warm pass: store promotions + recorder latency baseline, so the
         # measured phase sees steady state on both classes.
@@ -1738,9 +1751,16 @@ def run_obs_overhead_ab(n_requests: int = 4000, d: int = 32, E: int = 512):
             if med_ratio <= 1.05:
                 break
         retraces = engine.retraces_since_warmup
+        exporter.export_metrics()
+        exporter.flush(timeout_s=30.0)
+        otlp_health = exporter.health()
     finally:
         engine.close()
+        uninstall_exporter()
+        collector.close()
 
+    assert collector.span_batches, "exporter delivered no span batches"
+    assert otlp_health and otlp_health["exported_spans"] > 0
     p99_on, p99_off = p(lat_on, 0.99), p(lat_off, 0.99)
     assert retraces == 0, (
         f"{retraces} post-warmup retraces with the recorder on — "
@@ -1775,6 +1795,8 @@ def run_obs_overhead_ab(n_requests: int = 4000, d: int = 32, E: int = 512):
         "rounds": rounds,
         "retraces_after_warmup": retraces,
         "flight_recorder": flight_recorder().stats(),
+        "otlp_exporter": otlp_health,
+        "otlp_collector_requests": collector.requests_total,
         "sync_free_pin": "passed",
     }
 
@@ -2555,6 +2577,425 @@ def run_rollout_soak(E: int = 16, n_train: int = 512):
         "rolled_back_generation": gen4,
         "final_primary": os.path.basename(stats["primary"])
         if isinstance(stats.get("primary"), str) else stats.get("primary"),
+    }
+
+
+def run_slo_rollback_drill(E: int = 16, n_train: int = 512):
+    """SLO-breach → promotion-abort drill (PR 15 acceptance).
+
+    gen-1 serves live traffic (a slice of it traced end to end) with the
+    OTLP exporter shipping spans to a MockCollector and the watcher's
+    ``--slo-gate`` armed on second-scale drill burn windows. Then:
+
+      1. gen-2 publishes and enters shadow; an injected latency burn
+         (fed straight into the engine's SLOTracker — caller traffic
+         stays real and healthy) reaches paging, and the gate aborts the
+         shadow, poisons gen-2, and freezes promotions; clearing the
+         burn unfreezes.
+      2. gen-3 publishes, promotes, and — still inside its settle
+         window — the burn returns: the gate rolls back to gen-1,
+         poisons gen-3, repoints LATEST, and freezes again.
+      3. after the burn clears, gen-4 publishes and promotes normally,
+         proving the freeze actually lifted.
+
+    Acceptance: ZERO caller-visible errors and ZERO post-warmup
+    retraces throughout; every gate decision counted and kept as a
+    forced trace; at least one ``/metrics`` histogram line carries an
+    exemplar whose trace id resolves through ``photon-tpu-obs traces``
+    against the live endpoint; the exporter delivered span batches to
+    the collector, exemplars included.
+    """
+    import os
+    import tempfile
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import jax.numpy as jnp
+
+    from photon_tpu.cli import obs_tool
+    from photon_tpu.cli.game_serving import RolloutOptions, _reload_watcher
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        is_poisoned,
+        load_game_model,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.obs.export import (
+        MockCollector,
+        OTLPExporter,
+        install_exporter,
+        uninstall_exporter,
+    )
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.obs.slo import (
+        DRILL_PAGE_RULES,
+        DRILL_WARN_RULES,
+        SLOTracker,
+        default_objectives,
+    )
+    from photon_tpu.obs.trace import (
+        flight_recorder,
+        mint_context,
+        new_span_id,
+    )
+    from photon_tpu.serve import ServeConfig, ServingEngine
+    from photon_tpu.serve.frontend import (
+        INTERACTIVE,
+        LocalBackend,
+        make_http_handler,
+    )
+    from photon_tpu.train.incremental import (
+        compute_holdout_metrics,
+        incremental_update,
+    )
+    from photon_tpu.types import TaskType
+
+    d_fix, d_re = 5, 3
+    rng = np.random.default_rng(67)
+    w_fix = rng.normal(size=d_fix).astype(np.float32)
+    w_re = rng.normal(scale=1.5, size=(E, d_re)).astype(np.float32)
+
+    def make_batch(n, entities, seed):
+        r = np.random.default_rng(seed)
+        Xf = r.normal(size=(n, d_fix)).astype(np.float32)
+        Xf[:, 0] = 1.0
+        Xr = r.normal(size=(n, d_re)).astype(np.float32)
+        Xr[:, 0] = 1.0
+        users = r.choice(np.asarray(entities, np.int32), size=n)
+        logits = Xf @ w_fix + np.sum(Xr * w_re[users], axis=1)
+        y = (r.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+        return GameBatch(
+            label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+            weight=jnp.ones(n, jnp.float32),
+            features={"global": jnp.asarray(Xf), "per_user": jnp.asarray(Xr)},
+            entity_ids={"userId": jnp.asarray(users)},
+        )
+
+    root = tempfile.mkdtemp(prefix="slo-drill-")
+    imaps = {
+        "global": IndexMap.build([f"g{j}" for j in range(d_fix)]),
+        "per_user": IndexMap.build([f"r{j}" for j in range(d_re)]),
+    }
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"user{e}")
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    coord_configs = [
+        FixedEffectCoordinateConfig("global", "global"),
+        RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+    ]
+    suite = EvaluationSuite([EvaluatorSpec.parse("AUC")],
+                            num_entities={"userId": E})
+    valid = make_batch(256, list(range(E)), seed=2)
+
+    _progress("slo drill: training gen-1")
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=coord_configs,
+        num_iterations=2, num_entities={"userId": E},
+    )
+    (res,) = est.fit(make_batch(n_train, list(range(E)), seed=1),
+                     validation_batch=valid, evaluation_suite=suite)
+    g1 = os.path.join(root, "gen-1")
+    save_game_model(res.model, g1, imaps, {"userId": eidx},
+                    sparsity_threshold=0.0)
+    write_generation_manifest(
+        g1, parent=None,
+        holdout_metrics=compute_holdout_metrics(res.model, valid, suite))
+    assert gate_and_publish(root, "gen-1").ok
+
+    engine = ServingEngine(
+        load_game_model(g1, imaps, {"userId": eidx}, to_device=False),
+        entity_indexes={"userId": eidx}, index_maps=imaps,
+        config=ServeConfig(max_batch_size=8, max_delay_ms=1.0,
+                           hot_bytes=1 << 30, max_versions=4,
+                           shadow_fraction=1.0, promotion_settle_s=60.0),
+        model_version=g1,
+    )
+    # Second-scale burn windows so the drill pages (and clears) in
+    # seconds instead of the production tracker's hour-scale windows.
+    engine.slo = SLOTracker(
+        default_objectives(),
+        page_rules=DRILL_PAGE_RULES, warn_rules=DRILL_WARN_RULES,
+        bucket_s=1.0,
+    )
+    collector = MockCollector()
+    exporter = install_exporter(
+        OTLPExporter(collector.endpoint, flush_interval_s=0.1)
+    )
+    backend = LocalBackend(engine)
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", 0), make_http_handler(backend)
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base_url = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def gate_actions(action):
+        return registry().counter(
+            "serve_slo_gate_actions_total", action=action
+        ).value
+
+    base_act = {a: gate_actions(a) for a in (
+        "freeze", "unfreeze", "shadow_abort", "slo_rollback",
+    )}
+
+    Xf = rng.normal(size=(64, d_fix)).astype(np.float32)
+    Xf[:, 0] = 1.0
+    Xr = rng.normal(size=(64, d_re)).astype(np.float32)
+    Xr[:, 0] = 1.0
+
+    def raw(i, u):
+        return {"features": {"global": Xf[i], "per_user": Xr[i]},
+                "entityIds": {"userId": f"user{u}"}}
+
+    ok = errors = 0
+    lock = threading.Lock()
+    done = threading.Event()
+    burn_on = threading.Event()
+
+    def producer(seed):
+        nonlocal ok, errors
+        r = np.random.default_rng(seed)
+        n = 0
+        while not done.is_set():
+            n += 1
+            i = int(r.integers(0, 64))
+            u = int(r.integers(0, E))
+            try:
+                if n % 4 == 0:
+                    # A slice of live traffic is traced end to end: the
+                    # request carries the context through the engine (so
+                    # the latency histogram gets exemplars) and finishes
+                    # into the flight recorder + exporter.
+                    ctx = mint_context()
+                    t0 = time.perf_counter()
+                    backend.submit(
+                        raw(i, u), None, INTERACTIVE,
+                        trace=ctx.child(new_span_id()).to_dict(),
+                    ).result(120)
+                    flight_recorder().finish(
+                        ctx.trace_id, time.perf_counter() - t0
+                    )
+                else:
+                    backend.submit(raw(i, u), None, INTERACTIVE).result(120)
+                with lock:
+                    ok += 1
+            except Exception:  # noqa: BLE001 — any escape fails the drill
+                with lock:
+                    errors += 1
+            time.sleep(0.002)
+
+    def burner():
+        # The injected breach: latency-SLO-violating completions fed
+        # straight into the tracker (ok=True keeps availability green and
+        # the CALLER path untouched — real traffic never fails).
+        while not done.is_set():
+            if burn_on.is_set():
+                engine.slo.record_request(True, 2.0)
+                time.sleep(0.001)
+            else:
+                time.sleep(0.01)
+
+    producers = [threading.Thread(target=producer, args=(s,), daemon=True)
+                 for s in (201, 202)]
+    burn_thread = threading.Thread(target=burner, daemon=True)
+    t_start = time.perf_counter()
+    for t in producers:
+        t.start()
+    burn_thread.start()
+
+    def wait_for(pred, timeout, msg):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"slo drill: timed out waiting for {msg}")
+
+    def latest():
+        with open(os.path.join(root, "LATEST")) as f:
+            return f.read().strip()
+
+    def frozen():
+        return registry().gauge("serve_promotions_frozen").value
+
+    try:
+        # Phase 1: shadow abort. The quota is unreachable so the
+        # candidate stays in shadow until the gate decides.
+        _progress("slo drill: gen-2 shadow, latency burn → abort + freeze")
+        stop_a = threading.Event()
+        watcher_a = threading.Thread(
+            target=_reload_watcher,
+            args=(engine, root, 0.05, stop_a,
+                  RolloutOptions(shadow_fraction=1.0, shadow_quota=1 << 30,
+                                 divergence_bound=1e6, slo_gate=True,
+                                 max_reload_attempts=3, backoff_s=0.05)),
+            daemon=True,
+        )
+        watcher_a.start()
+        r2 = incremental_update(
+            root, make_batch(n_train, list(range(E)), seed=3), imaps,
+            {"userId": eidx}, TaskType.LOGISTIC_REGRESSION, coord_configs,
+            ["global", "per_user"], valid_batch=valid,
+            evaluation_suite=suite, num_iterations=1, metric_tolerance=0.2)
+        assert r2.published, r2.gate_reason
+        gen2 = r2.generation
+        wait_for(lambda: engine.shadow_stats()["version"] is not None, 60,
+                 f"{gen2} entering shadow")
+        burn_on.set()
+        wait_for(
+            lambda: gate_actions("shadow_abort") > base_act["shadow_abort"],
+            30, "SLO shadow abort")
+        assert is_poisoned(root, gen2), f"{gen2} not poisoned by the gate"
+        assert frozen() == 1, "promotions must freeze while paging"
+        burn_on.clear()
+        wait_for(lambda: gate_actions("unfreeze") > base_act["unfreeze"],
+                 30, "burn clear → unfreeze")
+        assert frozen() == 0
+        stop_a.set()
+        watcher_a.join(timeout=10)
+
+        # Phase 2: settle-window rollback. A small quota promotes the
+        # next generation fast; the burn returns inside the settle
+        # window and the gate unwinds the promotion.
+        _progress("slo drill: gen-3 promote, burn in settle → rollback")
+        unfreezes_after_a = gate_actions("unfreeze")
+        stop_b = threading.Event()
+        watcher_b = threading.Thread(
+            target=_reload_watcher,
+            args=(engine, root, 0.05, stop_b,
+                  RolloutOptions(shadow_fraction=1.0, shadow_quota=8,
+                                 divergence_bound=1e6, slo_gate=True,
+                                 max_reload_attempts=3, backoff_s=0.05)),
+            daemon=True,
+        )
+        watcher_b.start()
+        r3 = incremental_update(
+            root, make_batch(n_train, list(range(E)), seed=4), imaps,
+            {"userId": eidx}, TaskType.LOGISTIC_REGRESSION, coord_configs,
+            ["global", "per_user"], valid_batch=valid,
+            evaluation_suite=suite, num_iterations=1, metric_tolerance=0.2)
+        assert r3.published, r3.gate_reason
+        gen3 = r3.generation
+        wait_for(lambda: engine.model_version.endswith(gen3), 60,
+                 f"{gen3} promotion")
+        assert engine.promotion_in_window(), "promotion must be settling"
+        burn_on.set()
+        wait_for(
+            lambda: gate_actions("slo_rollback") > base_act["slo_rollback"],
+            30, "SLO rollback")
+        assert is_poisoned(root, gen3), f"{gen3} not poisoned on rollback"
+        wait_for(lambda: latest() == "gen-1", 30,
+                 "LATEST repointed to gen-1")
+        assert engine.model_version.endswith("gen-1")
+        burn_on.clear()
+        wait_for(lambda: gate_actions("unfreeze") > unfreezes_after_a, 30,
+                 "second unfreeze")
+
+        # Phase 3: the freeze actually lifted — a fresh generation
+        # walks shadow → promote end to end.
+        _progress("slo drill: gen-4 promotes after the burn cleared")
+        r4 = incremental_update(
+            root, make_batch(n_train, list(range(E)), seed=5), imaps,
+            {"userId": eidx}, TaskType.LOGISTIC_REGRESSION, coord_configs,
+            ["global", "per_user"], valid_batch=valid,
+            evaluation_suite=suite, num_iterations=1, metric_tolerance=0.2)
+        assert r4.published, r4.gate_reason
+        gen4 = r4.generation
+        wait_for(lambda: engine.model_version.endswith(gen4), 60,
+                 f"{gen4} post-unfreeze promotion")
+
+        done.set()
+        for t in producers:
+            t.join(timeout=10)
+        burn_thread.join(timeout=10)
+        wall = time.perf_counter() - t_start
+        retraces = engine.retraces_since_warmup
+        stop_b.set()
+        watcher_b.join(timeout=10)
+
+        # Exemplar loop: traced forced probes on a dedicated tenant give
+        # that tenant's latency histogram a deterministic freshest
+        # exemplar, scraped off the live /metrics endpoint and resolved
+        # back to its kept trace through the CLI.
+        _progress("slo drill: resolving a /metrics exemplar via the CLI")
+        probe_tid = None
+        for _ in range(4):
+            ctx = mint_context(forced=True)
+            t0 = time.perf_counter()
+            backend.submit(
+                raw(0, 0), "drill", INTERACTIVE,
+                trace=ctx.child(new_span_id()).to_dict(),
+            ).result(120)
+            flight_recorder().finish(
+                ctx.trace_id, time.perf_counter() - t0, forced=True
+            )
+            probe_tid = ctx.trace_id
+        with urllib.request.urlopen(base_url + "/metrics", timeout=30) as r:
+            metrics_text = r.read().decode()
+        drill_counts = [
+            s for s in obs_tool.parse_prometheus(metrics_text)
+            if s["name"] == "serve_tenant_latency_s_count"
+            and s["labels"].get("tenant") == "drill"
+        ]
+        assert drill_counts, "drill tenant histogram missing from /metrics"
+        ex = drill_counts[0].get("exemplar")
+        assert ex, "histogram _count line carries no exemplar"
+        ex_tid = ex["labels"]["trace_id"]
+        assert ex_tid == probe_tid, (ex_tid, probe_tid)
+        assert obs_tool.main(
+            ["--url", base_url, "traces", ex_tid, "--json"]
+        ) == 0, f"exemplar trace {ex_tid} did not resolve via the CLI"
+
+        exporter.export_metrics()
+        exporter.flush(timeout_s=30.0)
+        otlp_health = exporter.health()
+    finally:
+        done.set()
+        server.shutdown()
+        server.server_close()
+        engine.close()
+        uninstall_exporter()
+        collector.close()
+
+    assert errors == 0, f"{errors} caller-visible errors during the drill"
+    assert retraces == 0, f"{retraces} retraces after warm-up"
+    assert otlp_health["exported_spans"] > 0, otlp_health
+    assert ("serve_tenant_latency_s", ex_tid) in (
+        collector.metric_exemplar_trace_ids()
+    ), "collector never saw the exemplar"
+    decisions = {
+        a: gate_actions(a) - base_act[a]
+        for a in ("freeze", "unfreeze", "shadow_abort", "slo_rollback")
+    }
+    assert decisions["shadow_abort"] >= 1 and decisions["slo_rollback"] >= 1
+    assert decisions["freeze"] >= 2 and decisions["unfreeze"] >= 2
+    return {
+        "metric": "slo_rollback_drill",
+        "unit": "requests",
+        "value": ok,
+        "wall_s": round(wall, 3),
+        "ok": ok,
+        "caller_errors": errors,
+        "retraces": retraces,
+        "gate_decisions": decisions,
+        "aborted_generation": gen2,
+        "rolled_back_generation": gen3,
+        "final_primary": gen4,
+        "exemplar_trace_id": ex_tid,
+        "otlp_exporter": otlp_health,
+        "otlp_collector_requests": collector.requests_total,
     }
 
 
@@ -4218,6 +4659,13 @@ def main():
         # publish → shadow → promote → refuse a corrupt generation →
         # breaker-trip auto-rollback; zero caller errors, zero retraces.
         print(json.dumps(run_rollout_soak()))
+        return
+    if "--slo-rollback-drill" in sys.argv:
+        # SLO-breach actuation drill: injected latency burn aborts a
+        # shadow candidate (poisoned + frozen), rolls back a settling
+        # promotion, unfreezes once the burn clears; zero caller errors,
+        # zero retraces, and a /metrics exemplar resolves via the CLI.
+        print(json.dumps(run_slo_rollback_drill()))
         return
     if "--streaming-soak" in sys.argv:
         # Streaming freshness loop end to end: feedback spool → continuous

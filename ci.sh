@@ -554,15 +554,25 @@ run_obs() {
     # the tail-based flight recorder + /v1/traces, fleet-merged /metrics
     # with per-replica labels, metric-name aliases, and the SLO burn-rate
     # state machine (tests/test_obs_plane.py asserts the ISSUE 14 bar
-    # itself). Then the tracing-on vs tracing-off serve A/B: median
-    # per-pass p99 overhead <= 5%, zero post-warmup retraces with the
-    # recorder on, and the sync-free telemetry pin re-asserted.
-    echo "== obs: cross-process tracing + fleet /metrics + SLO plane =="
+    # itself). tests/test_obs_export.py covers the ISSUE 15 export loop:
+    # OTLP-shaped span/metric batches vs a mock collector, retry/backoff
+    # + drop-and-count on a dead collector, deterministic histogram
+    # exemplars, ring-overflow accounting, and the SLO gate's
+    # freeze/rollback/unfreeze cycle. Then the tracing-on vs tracing-off
+    # serve A/B (now WITH the exporter shipping every traced span to a
+    # live mock collector): median per-pass p99 overhead <= 5%, zero
+    # post-warmup retraces, sync-free telemetry pin re-asserted. Finally
+    # the SLO-breach actuation drill: injected latency burn aborts a
+    # shadow candidate and rolls back a settling promotion with zero
+    # caller errors, and a /metrics exemplar resolves through the CLI.
+    echo "== obs: tracing + export + fleet /metrics + SLO actuation =="
     JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
-        tests/test_obs_plane.py
-    echo "   obs plane tests OK"
+        tests/test_obs_plane.py tests/test_obs_export.py
+    echo "   obs plane + export tests OK"
     JAX_PLATFORMS=cpu python bench.py --obs-overhead-ab
     echo "   obs overhead A/B OK"
+    JAX_PLATFORMS=cpu python bench.py --slo-rollback-drill
+    echo "   SLO rollback drill OK"
 }
 
 run_kernels() {

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -32,6 +33,31 @@ from photon_tpu.obs.trace import span
 
 Array = jax.Array
 logger = logging.getLogger(__name__)
+
+
+@contextmanager
+def _export_trace():
+    """When an OTLP exporter is installed (``--otlp-endpoint``), run the
+    body under a minted trace context so its spans become traced and flow
+    through the tracer sink to the collector — the training-side
+    enrollment of the serve-side export path. Without an exporter this is
+    a no-op: spans stay untraced and pay nothing new. The trace is
+    finished against the flight recorder so the open-trace table never
+    accumulates training passes."""
+    from photon_tpu.obs.export import active_exporter
+
+    if active_exporter() is None:
+        yield
+        return
+    from photon_tpu.obs.trace import flight_recorder, mint_context, tracer
+
+    ctx = mint_context()
+    t0 = time.monotonic()
+    try:
+        with tracer().attach_context(ctx):
+            yield
+    finally:
+        flight_recorder().finish(ctx.trace_id, time.monotonic() - t0)
 
 
 @dataclasses.dataclass
@@ -254,7 +280,7 @@ class CoordinateDescent:
                 # Residual: all OTHER coordinates' scores
                 # (summedScores − thisCoordinateScores, reference :441-446).
                 residual = None if single else total_scores - scores[cid]
-                with span(f"cd/iter{it}/{cid}"):
+                with _export_trace(), span(f"cd/iter{it}/{cid}"):
                     with span("solve"):
                         model, diag = coord.train(batch, residual, models[cid])
                     with span("score"):

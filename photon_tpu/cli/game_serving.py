@@ -189,6 +189,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--feedback-join-ttl", type=float, default=300.0,
                    help="seconds a scored request waits for its label before "
                         "the pending join is dropped")
+    p.add_argument("--otlp-endpoint", default=None,
+                   help="base URL of an OTLP/HTTP collector accepting JSON "
+                        "(spans POST to <endpoint>/v1/traces, metrics to "
+                        "<endpoint>/v1/metrics). Export is bounded-queue + "
+                        "drop-and-count: a dead collector degrades "
+                        "observability, never scoring")
+    p.add_argument("--otlp-metrics-interval", type=float, default=15.0,
+                   help="seconds between registry-snapshot exports to the "
+                        "collector (0 = spans only)")
+    p.add_argument("--slo-gate", action="store_true",
+                   help="subscribe the rollout watcher to SLO burn state: a "
+                        "paging burn on availability/latency aborts an "
+                        "in-flight shadow, rolls back a promotion still in "
+                        "its settle window (candidate poisoned, LATEST "
+                        "repointed), and freezes further promotions until "
+                        "the burn clears")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -236,6 +252,11 @@ class RolloutOptions:
     max_reload_attempts: int = 3
     backoff_s: float = 0.2
     backoff_max_s: float = 5.0
+    # SLO actuation (--slo-gate): a paging burn on any objective in
+    # slo_objectives aborts shadows / rolls back unsettled promotions and
+    # freezes further promotions until the burn clears.
+    slo_gate: bool = False
+    slo_objectives: tuple = ("availability", "latency_p99")
 
 
 def _poison(publish_root: str, version: str, reason: str) -> None:
@@ -365,6 +386,41 @@ def _repoint_latest(publish_root: str, version: str) -> None:
             logger.exception("could not repoint LATEST to %r", name)
 
 
+def _slo_paging(engine, objectives) -> list:
+    """Gated objectives currently in PAGE state; [] when healthy (or when
+    the engine has no SLO tracker — the gate degrades to a no-op)."""
+    out = []
+    slo = getattr(engine, "slo", None)
+    if slo is None:
+        return out
+    for name in objectives:
+        try:
+            if slo.state(name) == "page":
+                out.append(name)
+        except (KeyError, AttributeError):
+            continue
+    return out
+
+
+def _trace_rollout_decision(action: str, version, reason: str) -> None:
+    """Every SLO-gate decision is counted AND kept as a forced trace, so
+    'why did my promotion abort' is answerable from /v1/traces alone."""
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.obs.trace import flight_recorder, mint_context, record_span
+
+    registry().counter("serve_slo_gate_actions_total", action=action).inc()
+    try:
+        ctx = mint_context(forced=True)
+        record_span(f"rollout/{action}", 0.0, parent="", context=ctx)
+        flight_recorder().finish(
+            ctx.trace_id, forced=True,
+            meta={"action": action, "version": str(version),
+                  "reason": reason},
+        )
+    except Exception:  # noqa: BLE001 — tracing never blocks the gate
+        logger.exception("could not trace rollout decision %r", action)
+
+
 def _reload_watcher(engine, model_dir: str, interval: float,
                     stop: threading.Event,
                     opts: Optional[RolloutOptions] = None) -> None:
@@ -382,13 +438,69 @@ def _reload_watcher(engine, model_dir: str, interval: float,
       breaker-trip delta crosses the bound is demoted back to its parent
       (engine rollback), poisoned, and LATEST is repointed to the parent.
 
-    A failed reload keeps the current model serving (engine guarantee)."""
+    With ``slo_gate`` the watcher also subscribes to the engine's
+    SLOTracker: a PAGING burn on a gated objective aborts an in-flight
+    shadow (candidate poisoned), rolls back a promotion still inside its
+    settle window (PR 8 rollback path: demote + poison + repoint LATEST),
+    and freezes promotions until the burn clears — every decision traced
+    (forced keep) and counted (``serve_slo_gate_actions_total``)."""
     from photon_tpu.io.model_io import is_poisoned
+    from photon_tpu.obs.metrics import registry
 
     opts = opts or RolloutOptions()
     current = _model_fingerprint(resolve_model_dir(model_dir))
     candidate: Optional[str] = None
+    frozen_reason: Optional[str] = None
     while not stop.wait(interval):
+        paging = (
+            _slo_paging(engine, opts.slo_objectives) if opts.slo_gate else []
+        )
+        if opts.slo_gate:
+            # Freeze lifecycle: any page freezes promotions; the freeze
+            # clears only when every gated objective stops paging (the
+            # short burn window is what makes that prompt).
+            if frozen_reason is not None and not paging:
+                logger.info(
+                    "SLO burn cleared (%s); promotions unfrozen",
+                    frozen_reason,
+                )
+                registry().gauge("serve_promotions_frozen").set(0)
+                _trace_rollout_decision(
+                    "unfreeze", engine.model_version, frozen_reason
+                )
+                frozen_reason = None
+            elif paging and frozen_reason is None:
+                frozen_reason = "slo_page: " + ",".join(paging)
+                logger.warning(
+                    "SLO paging (%s); promotions frozen", frozen_reason
+                )
+                registry().gauge("serve_promotions_frozen").set(1)
+                _trace_rollout_decision(
+                    "freeze", engine.model_version, frozen_reason
+                )
+        if paging and candidate is not None:
+            # Paging during shadow: the candidate is guilty until proven
+            # innocent — abort the promotion path and poison it.
+            reason = "slo_page: " + ",".join(paging)
+            engine.stop_shadow()
+            logger.warning(
+                "candidate %r aborted by SLO gate: %s", candidate, reason
+            )
+            _poison(model_dir, os.path.basename(candidate.rstrip("/")),
+                    reason)
+            _trace_rollout_decision("shadow_abort", candidate, reason)
+            candidate = None
+        if paging and engine.promotion_in_window():
+            # Paging during the settle window: unwind the promotion the
+            # same way breaker trips do.
+            reason = "slo_page: " + ",".join(paging)
+            demoted = engine.rollback(reason)
+            if demoted is not None:
+                _poison(model_dir,
+                        os.path.basename(str(demoted).rstrip("/")), reason)
+                _repoint_latest(model_dir, engine.model_version)
+                current = _model_fingerprint(resolve_model_dir(model_dir))
+                _trace_rollout_decision("slo_rollback", demoted, reason)
         # Shadow-phase verdicts for the current candidate, if any.
         if candidate is not None:
             st = engine.shadow_stats()
@@ -404,14 +516,21 @@ def _reload_watcher(engine, model_dir: str, interval: float,
                         reason)
                 candidate = None
             elif st["count"] >= opts.shadow_quota:
-                logger.info(
-                    "candidate %r passed shadow quota (%d scores, max "
-                    "divergence %.3g); promoting",
-                    candidate, st["count"], st["max_divergence"],
-                )
-                engine.promote(candidate)
-                _observe_staleness(candidate)
-                candidate = None
+                if frozen_reason is not None:
+                    # Quota met but promotions are frozen: hold the
+                    # candidate in shadow; it promotes after unfreeze.
+                    registry().counter(
+                        "serve_promotions_frozen_held_total"
+                    ).inc()
+                else:
+                    logger.info(
+                        "candidate %r passed shadow quota (%d scores, max "
+                        "divergence %.3g); promoting",
+                        candidate, st["count"], st["max_divergence"],
+                    )
+                    engine.promote(candidate)
+                    _observe_staleness(candidate)
+                    candidate = None
         # Post-promotion health: breaker-trip delta since the promotion.
         if opts.breaker_trip_bound > 0:
             trips = engine.trips_since_promotion()
@@ -427,6 +546,11 @@ def _reload_watcher(engine, model_dir: str, interval: float,
         target = resolve_model_dir(model_dir)
         fp = _model_fingerprint(target)
         if fp == current:
+            continue
+        if frozen_reason is not None:
+            # Frozen: leave ``current`` untouched so the generation is
+            # picked up on the first poll after the burn clears.
+            registry().counter("serve_promotions_frozen_held_total").inc()
             continue
         current = fp
         name = os.path.basename(target.rstrip("/"))
@@ -480,7 +604,35 @@ def _rollout_options(args) -> RolloutOptions:
         breaker_trip_bound=args.breaker_trip_bound,
         max_reload_attempts=args.reload_max_attempts,
         backoff_s=args.reload_backoff,
+        slo_gate=bool(getattr(args, "slo_gate", False)),
     )
+
+
+def _install_otlp(args, service_name: str):
+    """``--otlp-endpoint`` wiring, AFTER begin_run (tracer sinks survive
+    the reset, registry instruments do not). Returns the exporter or
+    None."""
+    from photon_tpu.obs.export import maybe_install_exporter
+
+    return maybe_install_exporter(
+        getattr(args, "otlp_endpoint", None), service_name,
+        metrics_interval_s=float(
+            getattr(args, "otlp_metrics_interval", 0.0) or 0.0
+        ),
+    )
+
+
+def _close_otlp(exporter) -> None:
+    if exporter is None:
+        return
+    from photon_tpu.obs.export import uninstall_exporter
+
+    try:
+        exporter.export_metrics()
+        exporter.flush(timeout_s=3.0)
+    except Exception:  # noqa: BLE001 — shutdown export is best-effort
+        logger.exception("final OTLP export failed")
+    uninstall_exporter()
 
 
 def _telemetry_max_bytes(args):
@@ -586,6 +738,7 @@ def _run_multiprocess(args):
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
     begin_run()
+    exporter = _install_otlp(args, "photon-tpu-serving")
     try:
         engine = _load_engine(args, _serve_config(args))
     except BaseException:
@@ -608,6 +761,7 @@ def _run_multiprocess(args):
             "game_serving", path=args.telemetry_out,
             max_bytes=_telemetry_max_bytes(args),
         )
+        _close_otlp(exporter)
         print(json.dumps({
             "serving": False,
             "stats": engine.stats(),
@@ -619,6 +773,7 @@ def _run_inprocess(args):
     from photon_tpu.obs import begin_run, finalize_run_report
 
     begin_run()
+    exporter = _install_otlp(args, "photon-tpu-serving")
     engine = _load_engine(args, _serve_config(args))
     server = ThreadingHTTPServer(
         (args.host, args.port), make_handler(engine)
@@ -646,6 +801,7 @@ def _run_inprocess(args):
             "game_serving", path=args.telemetry_out,
             max_bytes=_telemetry_max_bytes(args),
         )
+        _close_otlp(exporter)
         print(json.dumps({"serving": False, "stats": engine.stats()}))
 
 

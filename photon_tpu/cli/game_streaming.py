@@ -88,6 +88,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coordinate-descent-iterations", type=int, default=1)
     p.add_argument("--re-convergence-tol", type=float, default=1e-4)
     p.add_argument("--telemetry-out", default=None)
+    p.add_argument("--otlp-endpoint", default=None,
+                   help="base URL of an OTLP/HTTP collector accepting JSON; "
+                        "updater cycle spans and the metrics registry export "
+                        "there (bounded queue, drop-and-count on outage)")
+    p.add_argument("--otlp-metrics-interval", type=float, default=15.0,
+                   help="seconds between registry-snapshot exports (0 = "
+                        "spans only)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -102,6 +109,12 @@ def run(args) -> Dict:
     )
 
     begin_run()
+    from photon_tpu.obs.export import maybe_install_exporter, uninstall_exporter
+
+    exporter = maybe_install_exporter(
+        args.otlp_endpoint, "photon-tpu-streaming",
+        metrics_interval_s=float(args.otlp_metrics_interval or 0.0),
+    )
     task = task_of(args)
     coord_configs = [
         parse_coordinate_config(s) for s in args.coordinate_configurations
@@ -165,6 +178,13 @@ def run(args) -> Dict:
         updater.stop()
         cycles = updater.stats()["cycles"]
     finalize_run_report("game_streaming", path=args.telemetry_out)
+    if exporter is not None:
+        try:
+            exporter.export_metrics()
+            exporter.flush(timeout_s=3.0)
+        except Exception:  # noqa: BLE001 — export is best-effort at exit
+            logger.exception("final OTLP export failed")
+        uninstall_exporter()
     stats = updater.stats()
     return {
         "cycles": cycles,

@@ -155,6 +155,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the unified run report (spans + metrics + "
                         "coordinate-descent diagnostics) as schema-stable "
                         "JSONL to this path")
+    p.add_argument("--otlp-endpoint", default=None,
+                   help="base URL of an OTLP/HTTP collector accepting JSON; "
+                        "CD pass spans and the metrics registry export there "
+                        "(bounded queue, drop-and-count on outage — export "
+                        "never blocks training)")
+    p.add_argument("--otlp-metrics-interval", type=float, default=15.0,
+                   help="seconds between registry-snapshot exports (0 = "
+                        "spans only)")
     p.add_argument("--summarization-output-dir", default=None,
                    help="write per-feature summary statistics as "
                         "FeatureSummarizationResultAvro, one file per shard "
@@ -179,6 +187,14 @@ def run(args) -> Dict:
     from photon_tpu.utils import resources
 
     begin_run()  # fresh spans / metrics / phase records for THIS run
+    from photon_tpu.obs.export import maybe_install_exporter
+
+    otlp = maybe_install_exporter(
+        getattr(args, "otlp_endpoint", None), "photon-tpu-training",
+        metrics_interval_s=float(
+            getattr(args, "otlp_metrics_interval", 0.0) or 0.0
+        ),
+    )
     # Host RSS watchdog: inert without a detectable limit (cgroup or
     # PHOTON_TPU_RSS_LIMIT_BYTES); under pressure it tightens pipeline queue
     # depths / replay budgets, and the CD pass boundary fails cleanly at the
@@ -438,6 +454,15 @@ def run(args) -> Dict:
         finalize_run_report(
             "game_training", path=args.telemetry_out, emitter=emitter
         )
+        if otlp is not None:
+            from photon_tpu.obs.export import uninstall_exporter
+
+            try:
+                otlp.export_metrics()
+                otlp.flush(timeout_s=3.0)
+            except Exception:  # noqa: BLE001
+                pass
+            uninstall_exporter()
         raise SystemExit(128 + exc.signum) from exc
 
     # --- hyperparameter auto-tuning (runHyperparameterTuning role,
@@ -521,6 +546,15 @@ def run(args) -> Dict:
             for i, r in enumerate(pool)
         ],
     )
+    if otlp is not None:
+        from photon_tpu.obs.export import uninstall_exporter
+
+        try:
+            otlp.export_metrics()
+            otlp.flush(timeout_s=3.0)
+        except Exception:  # noqa: BLE001 — export is best-effort at exit
+            pass
+        uninstall_exporter()
     return summary
 
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 import urllib.error
@@ -100,6 +101,7 @@ def cmd_traces(args: argparse.Namespace) -> int:
     url = args.url.rstrip("/") + "/v1/traces"
     if args.limit is not None:
         url += "?" + urllib.parse.urlencode({"limit": args.limit})
+    wanted = getattr(args, "trace_id", None)
     shown = set()
     while True:
         try:
@@ -108,6 +110,14 @@ def cmd_traces(args: argparse.Namespace) -> int:
             print(f"photon-tpu-obs: {url}: {exc}", file=sys.stderr)
             return 1
         entries = payload.get("traces") or []
+        if wanted:
+            # Exemplar resolution: a trace_id scraped off a /metrics
+            # histogram line jumps straight to its kept span tree.
+            # Prefix match, so a truncated id from a dashboard works.
+            entries = [
+                e for e in entries
+                if str(e.get("traceId", "")).startswith(wanted)
+            ]
         fresh = [e for e in entries if e.get("traceId") not in shown]
         for e in fresh:
             shown.add(e.get("traceId"))
@@ -118,6 +128,14 @@ def cmd_traces(args: argparse.Namespace) -> int:
                 print()
         if not args.follow:
             if not entries:
+                if wanted:
+                    print(
+                        f"photon-tpu-obs: trace {wanted!r} not in the "
+                        "flight recorder (evicted, or kept by another "
+                        "process?)",
+                        file=sys.stderr,
+                    )
+                    return 1
                 print("(no kept traces)")
             return 0
         time.sleep(args.interval)
@@ -128,6 +146,57 @@ def cmd_traces(args: argparse.Namespace) -> int:
 # ---------------------------------------------------------------------------
 
 
+# One exposition sample, optionally carrying an OpenMetrics exemplar
+# (`name{labels} value # {trace_id="..."} exemplar_value`).
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?'
+    r'\s+(?P<value>[^\s#]+)'
+    r'(?:\s+#\s+\{(?P<exlabels>[^}]*)\}\s+(?P<exvalue>\S+))?\s*$'
+)
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_labels(blob: Optional[str]) -> Dict[str, str]:
+    if not blob:
+        return {}
+    return {k: v for k, v in _LABEL_RE.findall(blob)}
+
+
+def parse_prometheus(text: str) -> List[dict]:
+    """Parse a Prometheus/OpenMetrics text scrape into sample dicts
+    (``{"name", "labels", "value"}`` plus ``"exemplar"`` when the line
+    carries one). Comment/HELP/TYPE lines and malformed lines are
+    skipped — this is a triage tool, not a validator."""
+    samples: List[dict] = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        sample = {
+            "name": m.group("name"),
+            "labels": _parse_labels(m.group("labels")),
+            "value": value,
+        }
+        if m.group("exvalue") is not None:
+            try:
+                ex_value = float(m.group("exvalue"))
+            except ValueError:
+                ex_value = None
+            sample["exemplar"] = {
+                "labels": _parse_labels(m.group("exlabels")),
+                "value": ex_value,
+            }
+        samples.append(sample)
+    return samples
+
+
 def cmd_metrics(args: argparse.Namespace) -> int:
     url = args.url.rstrip("/") + "/metrics"
     try:
@@ -135,6 +204,14 @@ def cmd_metrics(args: argparse.Namespace) -> int:
     except (urllib.error.URLError, OSError) as exc:
         print(f"photon-tpu-obs: {url}: {exc}", file=sys.stderr)
         return 1
+    if getattr(args, "json", False):
+        samples = parse_prometheus(text)
+        if args.prefix:
+            samples = [
+                s for s in samples if s["name"].startswith(args.prefix)
+            ]
+        print(json.dumps({"samples": samples}, indent=2))
+        return 0
     for line in text.splitlines():
         if not args.prefix:
             print(line)
@@ -177,8 +254,16 @@ def cmd_slo(args: argparse.Namespace) -> int:
         return 1
     slo = _find_block(stats, "slo")
     sink = _find_block(stats, "telemetry_sink")
+    exporter = _find_block(stats, "otlp_exporter")
     if args.json:
-        print(json.dumps({"slo": slo, "telemetry_sink": sink}, indent=2))
+        print(json.dumps(
+            {
+                "slo": slo,
+                "telemetry_sink": sink,
+                "otlp_exporter": exporter,
+            },
+            indent=2,
+        ))
         return 0
     if slo is None:
         print("(no slo block in /healthz)")
@@ -202,6 +287,16 @@ def cmd_slo(args: argparse.Namespace) -> int:
             f" write_failures={sink.get('write_failures')}"
             f" last_write_error={sink.get('last_write_error')!r}"
         )
+    if exporter is not None:
+        print(
+            "otlp exporter: "
+            f"endpoint={exporter.get('endpoint')}"
+            f" queue={exporter.get('queue_depth')}/{exporter.get('queue_cap')}"
+            f" exported_spans={exporter.get('exported_spans')}"
+            f" dropped_spans={exporter.get('dropped_spans')}"
+            f" consecutive_failures={exporter.get('consecutive_failures')}"
+            f" last_error={exporter.get('last_error')!r}"
+        )
     return 0
 
 
@@ -223,6 +318,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     t = sub.add_parser("traces", help="dump kept flight-recorder traces")
+    t.add_argument("trace_id", nargs="?", default=None,
+                   help="show only this trace id (or unique prefix) — "
+                        "paste an exemplar's trace_id from a /metrics "
+                        "histogram line; exits 1 when absent")
     t.add_argument("--limit", type=int, default=None,
                    help="newest N traces only")
     t.add_argument("--follow", action="store_true",
@@ -236,6 +335,9 @@ def build_parser() -> argparse.ArgumentParser:
     m = sub.add_parser("metrics", help="dump the Prometheus text scrape")
     m.add_argument("--prefix", default=None,
                    help="only metrics whose name starts with this")
+    m.add_argument("--json", action="store_true",
+                   help="parse the exposition (labels, values, exemplars) "
+                        "and print one JSON document")
     m.set_defaults(fn=cmd_metrics)
 
     s = sub.add_parser("slo", help="show SLO burn state from /healthz")

@@ -18,6 +18,15 @@ stale state from a previous in-process invocation never leaks into this
 run's report) and ``finalize_run_report`` at exit.
 """
 
+from photon_tpu.obs.export import (  # noqa: F401
+    MockCollector,
+    OTLPExporter,
+    active_exporter,
+    exporter_health,
+    install_exporter,
+    maybe_install_exporter,
+    uninstall_exporter,
+)
 from photon_tpu.obs.metrics import (  # noqa: F401
     PROMETHEUS_CONTENT_TYPE,
     MetricsRegistry,

@@ -147,6 +147,11 @@ class Histogram(_Instrument):
 
     kind = "histogram"
     RESERVOIR_CAP = 4096
+    # Exemplar store: a handful of (value, trace_id) pairs linking the
+    # series to flight-recorder traces. Same deterministic keep-every-
+    # stride / halve-and-double scheme as the value reservoir (no RNG):
+    # two runs over the same traced sequence keep the same exemplars.
+    EXEMPLAR_CAP = 8
 
     def __init__(self, name: str, labels: LabelKey):
         super().__init__(name, labels)
@@ -157,8 +162,11 @@ class Histogram(_Instrument):
         self._sample: List[float] = []
         self._stride = 1
         self._since_kept = 0
+        self._exemplars: List[Tuple[float, str]] = []
+        self._ex_stride = 1
+        self._ex_since = 0
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         v = float(value)
         with self._lock:
             self.count += 1
@@ -172,6 +180,22 @@ class Histogram(_Instrument):
                 if len(self._sample) >= self.RESERVOIR_CAP:
                     self._sample = self._sample[::2]
                     self._stride *= 2
+            if trace_id:
+                self._ex_since += 1
+                if self._ex_since >= self._ex_stride:
+                    self._ex_since = 0
+                    self._exemplars.append((v, trace_id))
+                    if len(self._exemplars) >= self.EXEMPLAR_CAP:
+                        self._exemplars = self._exemplars[::2]
+                        self._ex_stride *= 2
+
+    def exemplars(self) -> List[dict]:
+        """Kept (value, trace_id) pairs, oldest first. The LAST one is
+        what the Prometheus render attaches (freshest link)."""
+        with self._lock:
+            return [
+                {"value": v, "traceId": tid} for v, tid in self._exemplars
+            ]
 
     @property
     def mean(self) -> Optional[float]:
@@ -206,6 +230,14 @@ class Histogram(_Instrument):
                 mean=self.sum / self.count if self.count else None,
                 **pcts,
             )
+            if self._exemplars:
+                # ``stats`` is an OPEN dict in the report schema
+                # (obs/report.py validates the envelope, not stats keys),
+                # so exemplars ride the existing record shape.
+                stats["exemplars"] = [
+                    {"value": v, "traceId": tid}
+                    for v, tid in self._exemplars
+                ]
         return dict(record="metric", metric=self.name, type=self.kind,
                     labels=self.label_dict(), value=None, stats=stats)
 
@@ -398,10 +430,22 @@ def render_prometheus(
                     f"{name}_sum{_prom_labels(labels)} "
                     f"{_prom_number(stats.get('sum', 0.0))}"
                 )
-                lines.append(
+                count_line = (
                     f"{name}_count{_prom_labels(labels)} "
                     f"{_prom_number(stats.get('count', 0))}"
                 )
+                # OpenMetrics exemplar: link the freshest kept
+                # (value, trace_id) pair to the series so a scrape can
+                # jump from a latency bucket straight to the flight-
+                # recorder trace (photon-tpu-obs traces <trace_id>).
+                exemplars = stats.get("exemplars")
+                if exemplars:
+                    ex = exemplars[-1]
+                    count_line += (
+                        f' # {{trace_id="{ex["traceId"]}"}}'
+                        f' {_prom_number(ex["value"])}'
+                    )
+                lines.append(count_line)
             else:
                 value = snap.get("value")
                 if value is None:

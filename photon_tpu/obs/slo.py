@@ -50,6 +50,17 @@ DEFAULT_WARN_RULES: Tuple[Tuple[float, float, float], ...] = (
     (21600.0, 1800.0, 6.0),
 )
 
+# Second-scale rules for drills and CI: the same state machine, but with
+# windows a test can traverse in wall time — a sustained burn pages in a
+# few seconds and CLEARS a few seconds after the bleeding stops (the
+# short window is what resets the page).
+DRILL_PAGE_RULES: Tuple[Tuple[float, float, float], ...] = (
+    (30.0, 5.0, 10.0),
+)
+DRILL_WARN_RULES: Tuple[Tuple[float, float, float], ...] = (
+    (60.0, 10.0, 5.0),
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class Objective:
@@ -77,6 +88,22 @@ def default_objectives(
     return [
         Objective("availability", availability_target),
         Objective("latency_p99", latency_target, latency_threshold_s, "s"),
+        Objective(
+            "model_staleness_s", staleness_target, staleness_threshold_s, "s"
+        ),
+    ]
+
+
+def streaming_objectives(
+    cycle_target: float = 0.95,
+    staleness_threshold_s: float = 120.0,
+    staleness_target: float = 0.99,
+) -> List[Objective]:
+    """The updater-side SLO plane: micro-generation cycle success ratio
+    plus published-model freshness — measurable with NO server running
+    (the serve-side staleness objective only ticks at promote time)."""
+    return [
+        Objective("update_cycle", cycle_target),
         Objective(
             "model_staleness_s", staleness_target, staleness_threshold_s, "s"
         ),

@@ -435,6 +435,7 @@ class FlightRecorder:
         self.discarded_total = 0
         self.open_evicted_total = 0
         self.span_overflow_total = 0
+        self.ring_dropped_total = 0
         self.keep_all = os.environ.get("PHOTON_TPU_TRACE_KEEP_ALL") == "1"
 
     # -- tracer sink -------------------------------------------------------
@@ -518,6 +519,14 @@ class FlightRecorder:
             spans=[s.as_trace_dict() for s in spans],
         )
         with self._lock:
+            # The ring sheds its OLDEST kept tree when full; count the
+            # shed so sustained forced-keep traffic (every tree kept) is
+            # visible as overflow instead of silently rotating away.
+            if (
+                self._ring.maxlen is not None
+                and len(self._ring) >= self._ring.maxlen
+            ):
+                self.ring_dropped_total += 1
             self._ring.append(entry)
             self.kept_total += 1
         return reason
@@ -541,6 +550,7 @@ class FlightRecorder:
                 open=len(self._open),
                 open_evicted=self.open_evicted_total,
                 span_overflow=self.span_overflow_total,
+                ring_dropped=self.ring_dropped_total,
                 capacity=self.capacity,
                 latency_samples=self._lat.count,
                 slow_threshold_s=self._p99_cache,
@@ -554,6 +564,7 @@ class FlightRecorder:
             self.discarded_total = 0
             self.open_evicted_total = 0
             self.span_overflow_total = 0
+            self.ring_dropped_total = 0
             self._lat = Histogram("flight_latency_s", _label_key({}))
             self._p99_cache = None
             self._since_refresh = 0

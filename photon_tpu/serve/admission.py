@@ -211,10 +211,17 @@ class AdmissionController:
         with self._lock:
             self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
 
-    def observe_latency(self, tenant: Optional[str], latency_s: float) -> None:
+    def observe_latency(
+        self,
+        tenant: Optional[str],
+        latency_s: float,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        # trace_id (traced requests only) becomes an OpenMetrics exemplar
+        # on the series — the scrape-to-flight-recorder link.
         registry().histogram(
             "serve_tenant_latency_s", tenant=tenant or DEFAULT_TENANT
-        ).observe(latency_s)
+        ).observe(latency_s, trace_id=trace_id)
 
     def snapshot(self) -> Dict[str, Dict]:
         """Per-tenant admission state for ``/healthz`` and the soak bench."""

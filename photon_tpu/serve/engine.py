@@ -58,6 +58,7 @@ from photon_tpu.data.random_effect import bucket_dim
 from photon_tpu.estimators.game_transformer import GameTransformer
 from photon_tpu.models.game import GameModel
 from photon_tpu.obs.metrics import registry
+from photon_tpu.obs.export import exporter_health
 from photon_tpu.obs.report import telemetry_sink_health
 from photon_tpu.obs.slo import SLOTracker
 from photon_tpu.obs.trace import flight_recorder, tracer
@@ -593,7 +594,12 @@ class ServingEngine:
 
         def _observe_done(f):
             dt = time.monotonic() - t0
-            self.admission.observe_latency(tenant, dt)
+            # Traced requests stamp their trace id as an OpenMetrics
+            # exemplar on the tenant-latency histogram, linking the
+            # scrape to the flight-recorder tree for the same request.
+            tr = getattr(request, "trace", None)
+            tid = tr.get("traceId") if isinstance(tr, dict) else None
+            self.admission.observe_latency(tenant, dt, trace_id=tid)
             # SLO feed: availability (admitted requests that errored) and
             # latency for successes; staleness sampled per completion
             # against the last primary-generation change. All host math.
@@ -897,6 +903,14 @@ class ServingEngine:
             promo = self._promotion
             return self._total_trips() - promo["trips_at"] if promo else 0
 
+    def promotion_in_window(self) -> bool:
+        """True while a promotion is inside its ``promotion_settle_s``
+        monitoring window — the span during which the SLO gate may still
+        unwind it (after settle, a rollback target no longer exists)."""
+        with self._lock:
+            self._maybe_settle_promotion_locked()
+            return self._promotion is not None
+
     def rollback(self, reason: str = "") -> Optional[str]:
         """Demote the promoted generation back to its parent. Returns the
         demoted version (for the caller to poison), or None when there is
@@ -980,6 +994,7 @@ class ServingEngine:
             slo=self._slo_block(),
             telemetry_sink=telemetry_sink_health(),
             flight_recorder=flight_recorder().stats(),
+            otlp_exporter=exporter_health(),
         )
 
     def _slo_block(self) -> Dict:

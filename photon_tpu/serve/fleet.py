@@ -598,9 +598,14 @@ class FleetBackend:
         )
 
     def stats(self) -> dict:
+        from photon_tpu.obs.export import exporter_health
+
         return dict(
             fleet=self.router.fleet_snapshot(),
             replicas=self.router.replica_stats(),
+            # Frontend-process exporter health: a dead collector must be
+            # visible in /healthz without ever gating readiness.
+            otlp_exporter=exporter_health(),
         )
 
     def metrics_snapshots(self) -> List[dict]:

@@ -246,6 +246,12 @@ class StreamingUpdater:
         self._cycles = 0
         self._publishes = 0
         self._stop = threading.Event()
+        # Updater-side SLO plane: cycle success ratio + published-model
+        # freshness — the training half of the serve-side tracker, so
+        # staleness is measurable when no server is running.
+        from photon_tpu.obs.slo import SLOTracker, streaming_objectives
+
+        self.slo = SLOTracker(objectives=streaming_objectives())
 
     # -- cursor ------------------------------------------------------------
 
@@ -309,7 +315,37 @@ class StreamingUpdater:
     def run_once(self) -> Optional[CycleResult]:
         """Consume pending sealed segments into one gated micro-generation.
         Returns None when there is nothing (or not yet enough) to train on.
-        """
+
+        With an OTLP exporter installed the whole cycle runs under a
+        minted trace context (``stream/cycle`` root span), so the solve's
+        span tree flows to the collector; a failed cycle finishes its
+        trace with the error, making it a kept flight-recorder tree."""
+        from photon_tpu.obs.export import active_exporter
+
+        if active_exporter() is None:
+            return self._run_cycle()
+        from photon_tpu.obs.trace import (
+            flight_recorder,
+            mint_context,
+            span,
+            tracer,
+        )
+
+        ctx = mint_context()
+        t0 = time.monotonic()
+        err = None
+        try:
+            with tracer().attach_context(ctx), span("stream/cycle"):
+                return self._run_cycle()
+        except Exception as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            flight_recorder().finish(
+                ctx.trace_id, time.monotonic() - t0, error=err
+            )
+
+    def _run_cycle(self) -> Optional[CycleResult]:
         from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
         from photon_tpu.obs.metrics import registry
         from photon_tpu.train.incremental import incremental_update
@@ -436,7 +472,18 @@ class StreamingUpdater:
             if oldest_label_ts is not None:
                 staleness = time.time() - oldest_label_ts
                 reg.gauge("model_staleness_published_s").set(staleness)
+                # Same metric name the serving side publishes, so one SLO
+                # query covers both halves of the freshness loop — and the
+                # updater's own staleness objective sees every publish.
+                reg.gauge("model_staleness_s").set(staleness)
+                reg.histogram("model_staleness_hist_s").observe(staleness)
+                self.slo.record_staleness(staleness)
+            self.slo.record_event("update_cycle", True)
         else:
+            # A refused generation means the freshness loop made no
+            # progress this cycle — that burns the cycle objective even
+            # though containment worked as designed.
+            self.slo.record_event("update_cycle", False)
             reg.counter("stream_gate_rejects_total").inc()
             logger.warning(
                 "streaming generation %s refused by the gate (%s); segments "
@@ -469,8 +516,10 @@ class StreamingUpdater:
                 result = self.run_once()
             except Exception:  # noqa: BLE001 — cycle containment
                 registry().counter("stream_cycle_failures_total").inc()
+                self.slo.record_event("update_cycle", False)
                 logger.exception("streaming update cycle failed; retrying")
                 result = None
+            self.slo.publish_metrics()
             if result is not None:
                 done += 1
                 if max_cycles is not None and done >= max_cycles:
@@ -486,4 +535,5 @@ class StreamingUpdater:
             "cycles": self._cycles,
             "publishes": self._publishes,
             "consumed_through": self.consumed_through(),
+            "slo": self.slo.snapshot(),
         }

@@ -165,7 +165,10 @@ def test_merge_trace_dumps_reassembles_processes():
 _PROM_LINE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
     r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
-    r" -?[0-9.eE+-]+$"
+    r" -?[0-9.eE+-]+"
+    # OpenMetrics exemplar suffix on histogram _count lines:
+    # `... # {trace_id="<hex>"} <value>`
+    r'( # \{trace_id="[0-9a-f]+"\} -?[0-9.eE+-]+)?$'
 )
 
 

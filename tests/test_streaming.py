@@ -665,7 +665,10 @@ def test_updater_publishes_delta_and_moves_cursor(tmp_path):
     )
     # Idempotent: nothing new to consume.
     assert upd.run_once() is None
-    assert upd.stats() == {
+    st = upd.stats()
+    slo = st.pop("slo")
+    assert set(slo["objectives"]) == {"update_cycle", "model_staleness_s"}
+    assert st == {
         "cycles": 1, "publishes": 1, "consumed_through": 2,
     }
 
