@@ -4573,9 +4573,377 @@ def _backend_watchdog(
     done.set()
 
 
+# ---------------------------------------------------------------------------
+# --multichip: device-sharded GAME scaling ladder.
+#
+# Coordinate path: the entity-sharded RE coordinate (fixed S=8 consistent-hash
+# shard plan at EVERY device count — identical per-shard datasets and
+# programs, only placement varies) trains over 1/2/4/8 devices; the parent
+# asserts bit-identical coefficients vs the 1-device rung (np.array_equal),
+# zero post-warmup retraces, and an aggregate-throughput curve. Fused path:
+# the whole-program pjit step (FE L-BFGS + vmapped per-shard Newton in ONE
+# XLA program over the mesh) runs the same ladder; cross-mesh consistency is
+# allclose-level (the FE gradient psum reorders reductions across mesh
+# sizes), which is asserted and reported as such.
+#
+# Each rung runs in its OWN subprocess: the virtual-device count must be
+# fixed before the process's first JAX touch (force_virtual_cpu_devices
+# raises once the backend exists). On real hardware set
+# PHOTON_MULTICHIP_REAL=1 to skip the CPU forcing and use the chips present.
+#
+# Throughput accounting: devices here are VIRTUAL — 8 "devices" share this
+# host's CPU cores, so raw wall clock cannot show real-mesh scaling. Shards
+# are therefore trained one at a time with a sync after each (see
+# ShardedRandomEffectCoordinate.train), making each wall segment that
+# device's busy time for its own work; aggregate throughput is
+# Σ_devices(device samples / device busy seconds) — what a mesh of real
+# chips, each as fast as this host, would sustain. The raw wall-clock curve
+# is reported alongside, clearly labeled.
+
+MULTICHIP_LADDER = (1, 2, 4, 8)
+MULTICHIP_SEED = 11
+MULTICHIP_E = 768  # entities (ragged 16..64 rows each → ~30k samples)
+MULTICHIP_D_RE = 8
+MULTICHIP_WARMUP = 2
+MULTICHIP_STEADY = 3
+
+
+def _multichip_workload():
+    """Seed-fixed ragged RE workload, identical at every rung."""
+    rng = np.random.default_rng(MULTICHIP_SEED)
+    counts = rng.integers(16, 64, size=MULTICHIP_E)
+    eids = np.repeat(np.arange(MULTICHIP_E, dtype=np.int32), counts)
+    n = eids.size
+    Xr = rng.normal(size=(n, MULTICHIP_D_RE)).astype(np.float32)
+    Xr[:, 0] = 1.0
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+    # Deterministic per-sample offsets stand in for the FE coordinate's
+    # residual scores (identical bytes at every rung by construction).
+    offsets = (0.25 * np.sin(np.arange(n, dtype=np.float32))).astype(np.float32)
+    return eids, Xr, y, w, offsets
+
+
+def run_multichip_worker(n_devices: int, out_prefix: str) -> None:
+    """One rung of the --multichip ladder (subprocess body). Writes
+    <out_prefix>.npy (merged coefficients — the parity artifact),
+    <out_prefix>.fused.npy (fused-step coefficient slab), and
+    <out_prefix>.json (walls, busy seconds, retrace counts)."""
+    import os
+
+    if not os.environ.get("PHOTON_MULTICHIP_REAL"):
+        from photon_tpu.utils.virtual_devices import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(n_devices)
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.algorithm.sharded_random_effect import (
+        ShardedRandomEffectCoordinate,
+    )
+    from photon_tpu.algorithm.solve_cache import SolveCache
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.random_effect import RandomEffectDataConfig
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.factory import OptimizerSpec
+    from photon_tpu.types import OptimizerType, TaskType
+
+    devs = jax.devices()[:n_devices]
+    if len(devs) != n_devices:
+        raise RuntimeError(
+            f"rung wants {n_devices} devices, backend has {len(devs)}"
+        )
+    eids, Xr, y, w, offsets = _multichip_workload()
+    n = eids.size
+    batch = GameBatch(
+        label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.asarray(w), features={"re": jnp.asarray(Xr)},
+        entity_ids={"userId": jnp.asarray(eids)},
+    )
+    cfg = RandomEffectDataConfig(
+        re_type="userId", feature_shard="re", n_buckets=4,
+        shape_bucketing=True, subspace_projection=False,
+    )
+    cache = SolveCache(donate=True)
+    coord = ShardedRandomEffectCoordinate.build(
+        coordinate_id="per_user",
+        entity_ids=eids, features=Xr, label=y, weight=w,
+        num_entities=MULTICHIP_E, config=cfg,
+        task=TaskType.LOGISTIC_REGRESSION,
+        objective=GLMObjective(loss=LogisticLoss, l2_weight=0.5),
+        optimizer_spec=OptimizerSpec(
+            optimizer=OptimizerType.NEWTON, max_iter=4, tol=1e-9
+        ),
+        devices=devs, solve_cache=cache,
+    )
+    model = None
+    retraces, pass_walls = [], []
+    off = jnp.asarray(offsets)
+    for it in range(MULTICHIP_WARMUP + MULTICHIP_STEADY):
+        coord.begin_cd_pass(it)
+        mark = cache.trace_mark()
+        t0 = time.perf_counter()
+        model, _ = coord.train(batch, off, model)
+        pass_walls.append(time.perf_counter() - t0)
+        retraces.append(cache.traces_since(mark))
+    busy = coord.device_busy_seconds(n_devices)
+    dev_samples = [0] * n_devices
+    for s, cnt in enumerate(coord.last_shard_samples):
+        dev_samples[coord.plan.device_of(s, n_devices)] += int(cnt)
+    aggregate = sum(
+        cnt / max(b, 1e-9) for cnt, b in zip(dev_samples, busy) if cnt
+    )
+    steady_wall = min(pass_walls[MULTICHIP_WARMUP:])
+    np.save(out_prefix + ".npy",
+            np.asarray(model.coefficients, np.float32))
+
+    fused = _multichip_fused_rung(n_devices, devs, out_prefix)
+
+    out = {
+        "n_devices": n_devices,
+        "backend": jax.default_backend(),
+        "n_samples": int(n),
+        "n_entities": MULTICHIP_E,
+        "retraces_per_pass": [int(r) for r in retraces],
+        "post_warmup_retraces": int(sum(retraces[MULTICHIP_WARMUP:])),
+        "pass_walls_s": pass_walls,
+        "steady_wall_s": steady_wall,
+        "shard_walls_s": coord.last_shard_walls,
+        "device_busy_s": busy,
+        "device_samples": dev_samples,
+        "aggregate_samples_per_sec": aggregate,
+        "wall_samples_per_sec": n / steady_wall,
+        "plan": {"seed": coord.plan.seed,
+                 "ring_version": coord.plan.ring_version,
+                 "n_shards": coord.plan.n_shards},
+        "fused": fused,
+    }
+    with open(out_prefix + ".json", "w") as f:
+        json.dump(out, f)
+
+
+def _multichip_fused_rung(n_devices: int, devs, out_prefix: str) -> dict:
+    """Whole-program pjit step (FE + sharded RE in one XLA program) at this
+    rung's mesh. Uniform rows/entity so the per-shard blocks stack into one
+    leading-shard-axis pytree. Saves the coefficient slab for the parent's
+    cross-mesh allclose check."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import LabeledBatch
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.common import OptimizerConfig
+    from photon_tpu.parallel.entity_shard import build_shard_plan
+    from photon_tpu.parallel.mesh import make_mesh
+    from photon_tpu.parallel.train_step import (
+        game_entity_sharded_train_step,
+        stack_shard_blocks,
+    )
+
+    S = 8
+    rng = np.random.default_rng(MULTICHIP_SEED + 1)
+    E, d_re, d_fe, rows_per = 256, 4, 16, 24
+    n = E * rows_per  # divisible by 8 → rows shard evenly at every rung
+    eids = np.repeat(np.arange(E, dtype=np.int32), rows_per)[
+        rng.permutation(n)
+    ]
+    Xf = rng.normal(size=(n, d_fe)).astype(np.float32)
+    Xr = rng.normal(size=(n, d_re)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+
+    plan = build_shard_plan(E, n_shards=S, seed=0)
+    cfg = RandomEffectDataConfig(
+        re_type="userId", feature_shard="re", n_buckets=1,
+        shape_bucketing=True, subspace_projection=False,
+    )
+    blocks = []
+    for s, se in enumerate(plan.shard_sample_entities(eids)):
+        ds = build_random_effect_dataset(
+            se, Xr, y, w, int(plan.counts[s]), cfg
+        )
+        blocks.append(ds.blocks[0])
+    stacked = stack_shard_blocks(blocks)
+    E_s = stacked.entity_idx.shape[1]
+
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+    mesh = make_mesh(n_data=n_devices, devices=devs)
+    step, place = game_entity_sharded_train_step(
+        mesh, obj, obj,
+        OptimizerConfig(max_iter=10, tol=1e-8),
+        OptimizerConfig(max_iter=4, tol=1e-9),
+    )
+    fe = LabeledBatch(
+        label=jnp.asarray(y), features=jnp.asarray(Xf),
+        offset=jnp.zeros(n, jnp.float32), weight=jnp.asarray(w),
+    )
+    args = place(
+        np.zeros(d_fe, np.float32), np.zeros((S, E_s, d_re), np.float32),
+        fe, stacked, Xr,
+        plan.shard_of[eids].astype(np.int32),
+        plan.local_of[eids].astype(np.int32),
+    )
+    wf, rc = args[0], args[1]
+    wf, rc, _, _, _ = step(wf, rc, *args[2:])  # warmup/compile pass
+    jax.block_until_ready(rc)
+    t0 = time.perf_counter()
+    wf, rc, scores, fe_evals, visits = step(wf, rc, *args[2:])
+    jax.block_until_ready(rc)
+    wall = time.perf_counter() - t0
+    np.save(out_prefix + ".fused.npy", np.asarray(rc, np.float32))
+    return {
+        "mesh_shape": dict(mesh.shape),
+        "steady_wall_s": wall,
+        "n_samples": int(n),
+        "w_fixed": np.asarray(wf, np.float32).tolist(),
+        "fe_evals": int(np.asarray(fe_evals)),
+        "visits": int(np.asarray(visits)),
+    }
+
+
+def run_multichip() -> dict:
+    """Parent orchestrator: step-zero single-chip probe, then the
+    1/2/4/8-device subprocess ladder with parity / retrace / scaling
+    asserts. Writes MULTICHIP_r06.json next to this script."""
+    import os
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    metric = "multichip_re_aggregate_samples_per_sec"
+
+    # Step zero: the single-chip headline re-land goes through the backend
+    # probe first — a wedged axon tunnel must fail fast with a recorded
+    # diagnosis (and the CPU-mesh ladder still runs) instead of hanging.
+    probe = _probe_backend_subprocess(timeout_s=120.0)
+    if probe.get("ok") and probe.get("backend") == "tpu":
+        step_zero = {"probe": probe, "headline": "run `bench.py --pack` "
+                     "for the full single-chip ladder on this backend"}
+    else:
+        line = _artifact_line(
+            "glmix_logistic_samples_per_sec_per_chip",
+            "backend_init_failed" if not probe.get("ok") else "cpu-backend",
+            f"step-zero single-chip probe: {probe}; keeping the CPU-mesh "
+            "headline (BENCH_FULL.md) with on-chip verdicts pending",
+        )
+        print(json.dumps(line), flush=True)
+        step_zero = {"probe": probe, "artifact": line}
+
+    results = {}
+    tmpdir = tempfile.mkdtemp(prefix="multichip_")
+    for nd in MULTICHIP_LADDER:
+        prefix = os.path.join(tmpdir, f"rung{nd}")
+        _progress(f"multichip: rung n_devices={nd}")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--multichip-worker", str(nd), prefix],
+            capture_output=True, text=True, timeout=1800,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"multichip rung n={nd} failed rc={proc.returncode}: "
+                + (proc.stderr or proc.stdout).strip()[-2000:]
+            )
+        with open(prefix + ".json") as f:
+            results[nd] = json.load(f)
+        results[nd]["_coefs"] = np.load(prefix + ".npy")
+        results[nd]["_fused_rc"] = np.load(prefix + ".fused.npy")
+
+    ref = results[MULTICHIP_LADDER[0]]
+    parity = {
+        nd: bool(np.array_equal(results[nd]["_coefs"], ref["_coefs"]))
+        for nd in MULTICHIP_LADDER
+    }
+    assert all(parity.values()), f"bit-parity vs 1-device broke: {parity}"
+    retraces = {
+        nd: results[nd]["post_warmup_retraces"] for nd in MULTICHIP_LADDER
+    }
+    assert all(v == 0 for v in retraces.values()), (
+        f"post-warmup retraces: {retraces}"
+    )
+    fused_consistency = {
+        nd: float(np.abs(
+            results[nd]["_fused_rc"] - ref["_fused_rc"]
+        ).max())
+        for nd in MULTICHIP_LADDER
+    }
+    assert all(d <= 1e-3 for d in fused_consistency.values()), (
+        f"fused-step cross-mesh drift: {fused_consistency}"
+    )
+
+    agg = {
+        nd: results[nd]["aggregate_samples_per_sec"]
+        for nd in MULTICHIP_LADDER
+    }
+    scaling = agg[MULTICHIP_LADDER[-1]] / agg[MULTICHIP_LADDER[0]]
+    assert scaling >= 3.0, (
+        f"aggregate scaling at {MULTICHIP_LADDER[-1]} devices is "
+        f"{scaling:.2f}x (< 3x bar)"
+    )
+    curve = {
+        str(nd): {
+            "aggregate_samples_per_sec": agg[nd],
+            "wall_samples_per_sec": results[nd]["wall_samples_per_sec"],
+            "steady_wall_s": results[nd]["steady_wall_s"],
+            "device_busy_s": results[nd]["device_busy_s"],
+            "fused_steady_wall_s": results[nd]["fused"]["steady_wall_s"],
+        }
+        for nd in MULTICHIP_LADDER
+    }
+    out = {
+        "metric": metric,
+        "value": agg[MULTICHIP_LADDER[-1]],
+        "unit": "samples/s aggregate (sum of per-device busy-time rates; "
+                "virtual devices share cores — raw wall alongside)",
+        "backend": ref["backend"],
+        "scaling_vs_1dev": scaling,
+        "parity_vs_1dev": parity,
+        "post_warmup_retraces": retraces,
+        "fused_max_abs_drift_vs_1dev": fused_consistency,
+        "curve": curve,
+        "step_zero": step_zero,
+    }
+    tail = (
+        f"multichip OK: parity {sorted(parity)}, retraces 0, "
+        f"aggregate x{scaling:.2f} at {MULTICHIP_LADDER[-1]} devices, "
+        f"fused drift ≤ {max(fused_consistency.values()):.2e}"
+    )
+    with open(os.path.join(here, "MULTICHIP_r06.json"), "w") as f:
+        json.dump({"n_devices": MULTICHIP_LADDER[-1], "rc": 0, "ok": True,
+                   "skipped": False, "tail": tail, "result": out}, f,
+                  indent=2)
+    return out
+
+
 def main():
     import sys
 
+    if "--multichip-worker" in sys.argv:
+        # MUST dispatch before anything can touch jax: the worker forces
+        # the virtual-device count as the process's first JAX operation.
+        i = sys.argv.index("--multichip-worker")
+        try:
+            nd, prefix = int(sys.argv[i + 1]), sys.argv[i + 2]
+        except (IndexError, ValueError):
+            print("usage: bench.py --multichip-worker <n_devices> <out_prefix>",
+                  file=sys.stderr)
+            sys.exit(2)
+        run_multichip_worker(nd, prefix)
+        return
+    if "--multichip" in sys.argv:
+        # Device-sharded GAME scaling ladder over 1/2/4/8 (virtual) devices:
+        # bit-parity vs single-device asserted, zero post-warmup retraces,
+        # ≥3x aggregate throughput at 8 devices; subprocess per rung. Step
+        # zero re-lands the single-chip headline through the backend probe
+        # (wedged tunnel → backend_init_failed artifact, ladder still runs).
+        print(json.dumps(run_multichip()))
+        return
     if "--measure-cpu-baseline" in sys.argv:
         measure_cpu_baseline()
         return
@@ -4734,6 +5102,17 @@ def main():
         from bench_configs import run_rmatvec_cpu_ab
 
         print(json.dumps(run_rmatvec_cpu_ab()))
+        return
+    if "--rmatvec-sharded-ab" in sys.argv:
+        # Scatter vs segment-sum rmatvec on the SHARDED path (batch rows
+        # over an 8-virtual-device mesh — the multichip FE step's actual
+        # lowering). Informs the _TRANSPOSE_PLAN_* pins in data/batch.py.
+        from photon_tpu.utils.virtual_devices import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(8)
+        from bench_configs import run_rmatvec_sharded_ab
+
+        print(json.dumps(run_rmatvec_sharded_ab()))
         return
     _backend_watchdog()
     try:

@@ -699,6 +699,112 @@ def run_rmatvec_cpu_ab() -> dict:
     )
 
 
+def run_rmatvec_sharded_ab() -> dict:
+    """Scatter-add vs column-sorted segment-sum rmatvec ON THE SHARDED
+    PATH: the run_rmatvec_cpu_ab head-to-head re-run with the batch rows
+    sharded over an 8-virtual-device mesh, so the gradient's transpose
+    product lowers to per-device partial rmatvec + one cross-device
+    reduction — the multichip FE step's actual program. The structural
+    asymmetry this measures: the scatter-add partitions trivially on the
+    sample axis (each device scatters ITS rows, psum merges), while the
+    column-sorted plan's flat (n·k,) gather/segment arrays cut across the
+    row partition, forcing SPMD to insert collectives (or replicate the
+    nnz stream) before it can segment-sum.
+
+    Must run in a process whose FIRST jax touch forced the 8-device mesh
+    (``bench.py --rmatvec-sharded-ab`` does). Scaled down from the
+    unsharded A/B (n=2^15, d=2^14) — the verdict wanted is the lowering
+    ORDERING under sharding, not peak numbers."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from photon_tpu.data.batch import (
+        LabeledBatch,
+        SparseFeatures,
+        default_transpose_plan,
+    )
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optim.common import OptimizerConfig
+    from photon_tpu.optim.margin_lbfgs import minimize_lbfgs_margin
+    from photon_tpu.parallel.mesh import make_mesh
+
+    n, d, k, iters = 1 << 15, 1 << 14, _RM_K, _RM_ITERS
+    mesh = make_mesh(n_data=8, devices=jax.devices()[:8])
+    rows = NamedSharding(mesh, PartitionSpec("data"))
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    rng = np.random.default_rng(_SP_SEED)
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    idx[:, 0] = 0
+    vals[:, 0] = 1.0
+    w_true = (rng.normal(size=d) / 8.0).astype(np.float32)
+    z = np.sum(vals * w_true[idx], axis=1)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    flat = idx.reshape(-1)
+    order = np.argsort(flat, kind="stable")
+
+    def put(x, sh):
+        return jax.device_put(jnp.asarray(x), sh)
+
+    variants = {
+        "scatter": SparseFeatures(put(idx, rows), put(vals, rows), d),
+        "segsum": SparseFeatures(
+            put(idx, rows), put(vals, rows), d,
+            put(order.astype(np.int32), rows),
+            put(flat[order].astype(np.int32), rows),
+        ),
+    }
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0, intercept_index=0)
+    cfg = OptimizerConfig(max_iter=iters, track_history=False)
+
+    @jax.jit
+    def solve(w0, b):
+        res = minimize_lbfgs_margin(obj, b, w0, cfg)
+        return res.w, res.evals
+
+    walls, sols = {}, {}
+    best = None
+    for variant, feats in variants.items():
+        batch = LabeledBatch(put(y, rows), feats)
+        jax.block_until_ready(batch.features.values)
+        _progress(f"rmatvec sharded A/B: compiling + warm-up ({variant})")
+        w, _ = solve(put(np.zeros(d, np.float32), repl), batch)
+        float(jnp.sum(w))
+        times = []
+        for rep in range(3):
+            t0 = time.perf_counter()
+            w, _ = solve(
+                put(np.full(d, 1e-6 * (rep + 1), np.float32), repl), batch
+            )
+            float(jnp.sum(w))
+            times.append(time.perf_counter() - t0)
+        walls[f"rmatvec_{variant}_sharded_wall_s"] = round(min(times), 4)
+        sols[variant] = np.asarray(w)
+        if best is None or min(times) < best[0]:
+            best = (min(times), variant)
+    # Both lowerings compute the same transpose product; under sharding the
+    # reduction grouping differs, so parity is allclose-level.
+    max_dw = float(np.abs(sols["scatter"] - sols["segsum"]).max())
+    return dict(
+        metric="rmatvec_sharded_ab_best_wall_s",
+        value=best[0],
+        unit="s",
+        winner=best[1],
+        n=n,
+        d=d,
+        nnz_per_row=k,
+        iters=iters,
+        mesh_devices=int(np.prod(list(mesh.shape.values()))),
+        backend=jax.default_backend(),
+        max_abs_dw=max_dw,
+        default_transpose_plan=default_transpose_plan(),
+        **walls,
+    )
+
+
 # --------------------------------------------------------------------------
 # Config 5: full GAME + Bayesian auto-tune (wall-clock)
 # --------------------------------------------------------------------------

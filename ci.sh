@@ -44,6 +44,34 @@ run_unit() {
 run_dryrun() {
     echo "== multichip dryrun (8-device mesh compile + run + parity) =="
     python __graft_entry__.py
+    # Device-sharded coordinate gate: sharded-at-8 vs sharded-at-1 RE
+    # coefficients must be bit-identical, with zero post-warmup solve-cache
+    # retraces at both device counts (subprocess per count — the virtual
+    # mesh width must be fixed before the first jax touch).
+    echo "== multichip gate (sharded-vs-single parity + zero retrace) =="
+    tmp="$(mktemp -d)"
+    for n in 1 8; do
+        python bench.py --multichip-worker "$n" "$tmp/rung$n"
+    done
+    python - "$tmp" <<'EOF'
+import json, sys
+import numpy as np
+
+tmp = sys.argv[1]
+c1 = np.load(f"{tmp}/rung1.npy")
+c8 = np.load(f"{tmp}/rung8.npy")
+assert np.array_equal(c1, c8), "sharded-at-8 != sharded-at-1 (bit parity)"
+for n in (1, 8):
+    with open(f"{tmp}/rung{n}.json") as f:
+        r = json.load(f)
+    assert r["post_warmup_retraces"] == 0, (n, r["retraces_per_pass"])
+f1 = np.load(f"{tmp}/rung1.fused.npy")
+f8 = np.load(f"{tmp}/rung8.fused.npy")
+drift = float(np.abs(f1 - f8).max())
+assert drift <= 1e-3, f"fused-step cross-mesh drift {drift}"
+print(f"   parity OK, retraces 0, fused drift {drift:.2e}")
+EOF
+    rm -rf "$tmp"
 }
 
 run_telemetry() {

@@ -323,6 +323,14 @@ class RandomEffectCoordinate(Coordinate):
     # CPU parity/bench path); "xla" forces the two-read einsum lowering.
     # Part of the solver-cache key, so variants never share executables.
     re_kernel: str = "auto"
+    # Device placement for the entity-sharded multi-device path
+    # (parallel/entity_shard.py): commit this coordinate's blocks,
+    # coefficients, and solves to ONE device. The solve cache needs no
+    # per-device keying — the same jitted executable serves every device of
+    # a backend (one trace, bit-identical results), so sharded coordinates
+    # share cache entries whenever their block geometry matches. None keeps
+    # the default (backend-chosen) placement.
+    device: Optional[object] = None
 
     def __post_init__(self):
         self.compute_variance = normalize_variance_type(self.compute_variance)
@@ -337,6 +345,18 @@ class RandomEffectCoordinate(Coordinate):
         )
         self._store = None
         self.last_residency_stats: Optional[dict] = None
+        if self.device is not None:
+            if self.dataset.projected:
+                raise ValueError(
+                    "per-device placement supports dense RE datasets only "
+                    "(projected blocks route through the default device)"
+                )
+            if self.compute_variance != VarianceComputationType.NONE:
+                raise ValueError(
+                    "per-device placement does not support coefficient "
+                    "variance computation (the variance pass assembles on "
+                    "the default device)"
+                )
         if self.device_budget_bytes:
             if self.dataset.projected:
                 import logging
@@ -367,11 +387,18 @@ class RandomEffectCoordinate(Coordinate):
                     self.device_budget_bytes,
                     self.coordinate_id,
                     self.device_spill_dir,
+                    device=self.device,
                 )
                 # Drop the device references: from here on the dataset's
                 # blocks ARE the host master, and device placement happens
                 # only through the store's budgeted upload stage.
                 self.dataset.blocks = self._store.blocks
+        if self.device is not None and self._store is None:
+            # Commit every block to the owning device BEFORE derived state
+            # (Pearson masks inherit placement from the block arrays).
+            self.dataset.blocks = [
+                jax.device_put(b, self.device) for b in self.dataset.blocks
+            ]
         self._feature_masks: Dict[int, Array] = {}
         ratio = self.dataset.config.features_to_samples_ratio
         if ratio is not None:
@@ -677,6 +704,11 @@ class RandomEffectCoordinate(Coordinate):
         total_offset = batch.offset
         if residual_scores is not None:
             total_offset = total_offset + residual_scores
+        if self.device is not None:
+            # One (n,) h2d per pass: the flat residual vector follows the
+            # coordinate to its owning device so every block gather stays
+            # device-local (mixed-device eager ops would otherwise fail).
+            total_offset = jax.device_put(total_offset, self.device)
         if self.dataset.projected:
             return self._train_projected(total_offset, initial_model)
         if self._store is not None:
@@ -695,6 +727,11 @@ class RandomEffectCoordinate(Coordinate):
             if initial_model is not None
             else jnp.zeros((E, d), dtype)
         )
+        if self.device is not None:
+            # Host-numpy warm starts (out-of-core / sharded-merge models)
+            # and fresh zeros both commit to the owning device; a table
+            # already resident there passes through untouched.
+            coefs = jax.device_put(coefs, self.device)
         # Active-set gate: from pass 2 on (mask state + a warm model), only
         # still-active entities are re-solved, repacked onto already-compiled
         # shapes; converged entities keep their ``coefs`` rows untouched.
@@ -710,6 +747,20 @@ class RandomEffectCoordinate(Coordinate):
         else:
             entries = [self._identity_entry(i) for i in range(len(self.dataset.blocks))]
         tol = self.convergence_tol if self.active_set else None
+        if self.device is not None and gated:
+            # Compacted blocks are assembled on the default device; move
+            # them (and their mask rows) to the owning device. Identity
+            # entries are already resident — their puts are no-ops.
+            entries = [
+                (
+                    jax.device_put(block, self.device),
+                    obj,
+                    None if mask is None else jax.device_put(mask, self.device),
+                    sb,
+                    sr,
+                )
+                for block, obj, mask, sb, sr in entries
+            ]
 
         # Sync-free dispatch: issue EVERY block solve before touching any
         # result — no read-modify-write of ``coefs`` between dispatches, so

@@ -137,10 +137,15 @@ class ReDeviceStore:
         budget_bytes: int,
         coordinate_id: str,
         spill_dir: Optional[str] = None,
+        device=None,
     ):
         if spill_dir is not None:
             os.makedirs(spill_dir, exist_ok=True)
         self.coordinate_id = coordinate_id
+        # Entity-sharded placement (parallel/entity_shard.py): every upload
+        # pins to this device so the working set stays local to the shard's
+        # owner. None = backend default (the single-device path, unchanged).
+        self.device = device
         self.blocks: List[EntityBlock] = [
             host_entity_block(b, spill_dir, i) for i, b in enumerate(blocks)
         ]
@@ -256,7 +261,8 @@ class ReDeviceStore:
             reg.counter("re_store_upload_hits_total", **self._labels).inc()
         else:
             dev_block = self._upload_contained(
-                lambda: jax.device_put(host_block), f"block {key}"
+                lambda: jax.device_put(host_block, self.device),
+                f"block {key}",
             )
             nbytes = block_data_bytes(host_block)
             self.uploads += 1
@@ -271,7 +277,7 @@ class ReDeviceStore:
                 with self._cond:
                     self._resident[key] = dev_block
         w0 = self._upload_contained(
-            lambda: jax.device_put(np.ascontiguousarray(w0_host)),
+            lambda: jax.device_put(np.ascontiguousarray(w0_host), self.device),
             f"w0 for block {key}",
         )
         self._publish()

@@ -33,6 +33,13 @@ Key construction notes:
   traces, so ``stats.traces`` counts real retraces — including any the
   jit-level cache would hide — and the retrace-regression test in
   tests/test_solve_cache.py asserts on it directly.
+- Keys are deliberately DEVICE-POLYMORPHIC: no device or sharding
+  component. One traced executable serves every device of a backend, so
+  the entity-sharded coordinate (algorithm/sharded_random_effect.py) can
+  run S shards across N devices through one shared cache — warming it at
+  one device count leaves every other count with zero compiles
+  (tests/test_entity_sharded.py asserts this), and the multichip ladder's
+  zero-retrace bar needs no per-device keying.
 
 The same cache serves the fixed-effect objective (``fe_solver``): the full
 optimizer run over the sharded batch becomes one cached jitted program per

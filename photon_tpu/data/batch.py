@@ -28,10 +28,17 @@ Array = jax.Array
 # ``default_transpose_plan()``). CPU: measured head-to-head on this image's
 # CPU mesh (bench.py --rmatvec-cpu-ab, BENCH_FULL.md) — the duplicate-index
 # scatter-add beat the column-sorted segment_sum, so no plan is attached.
+# Re-confirmed on the SHARDED path (bench.py --rmatvec-sharded-ab, batch
+# rows over the 8-virtual-device mesh, 2026-08-06): scatter 0.384 s vs
+# segsum 0.439 s — the scatter partitions trivially on the sample axis
+# (per-device partial + psum) while the flat column-sorted (n·k,) plan
+# arrays cut across the row partition and cost SPMD collectives.
 # TPU: segment-sum is the native lowering (XLA:TPU serializes colliding
 # scatter updates, so the scatter path degenerates under index collisions);
 # pinned True pending the on-chip re-run of the A/B at full run_sparse_wide
-# scale — the CPU number does not transfer.
+# scale — the CPU number does not transfer, and per-device row partitions
+# shrink the collision profile, so the sharded on-chip A/B may narrow the
+# gap but is not expected to flip it.
 _TRANSPOSE_PLAN_CPU = False
 _TRANSPOSE_PLAN_TPU = True
 

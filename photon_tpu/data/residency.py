@@ -45,17 +45,27 @@ class SlotLru:
     the caller's ``protected`` set and reuses its slot. ``on_demote`` fires
     for every demotion (metric counters live with the caller, which knows
     its label space).
+
+    ``base`` offsets every slot this pool hands out: a store whose hot
+    table is split into per-device-shard segments runs one SlotLru per
+    segment over the segment's global slot range [base, base + capacity) —
+    segments stay disjoint by construction and the upload scatter keeps
+    addressing one (sharded) table.
     """
 
     def __init__(
         self,
         capacity: int,
         on_demote: Optional[Callable[[Hashable, int], None]] = None,
+        base: int = 0,
     ):
         self.capacity = int(capacity)
+        self.base = int(base)
         self._slot_of: "OrderedDict[Hashable, int]" = OrderedDict()
         # Popped from the end: slots assign in ascending order.
-        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._free: List[int] = list(
+            range(self.base + self.capacity - 1, self.base - 1, -1)
+        )
         self._on_demote = on_demote
 
     def __len__(self) -> int:

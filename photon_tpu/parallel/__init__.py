@@ -6,3 +6,10 @@ from photon_tpu.parallel.feature_sharded import (  # noqa: F401
     sparse_value_and_grad_feature_sharded,
     train_fixed_effect_feature_sharded,
 )
+from photon_tpu.parallel.entity_shard import (  # noqa: F401
+    DEFAULT_N_SHARDS,
+    EntityShardPlan,
+    build_shard_plan,
+    merge_shard_coefficients,
+    shard_members,
+)

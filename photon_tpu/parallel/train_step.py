@@ -203,3 +203,222 @@ def glmix_sharded_train_step(
         )
 
     return jax.jit(step, out_shardings=(repl, repl, rows, repl, repl)), place
+
+
+def stack_shard_blocks(shard_blocks, pad_entities: Optional[int] = None):
+    """Stack one EntityBlock per shard into a (S, ...)-leading EntityBlock
+    for :func:`game_entity_sharded_train_step`.
+
+    All shards must share (n_max, d); entity counts are padded up to
+    ``pad_entities`` (default: the max across shards) with -1/zero padding
+    rows — the same filler discipline shape bucketing uses, so the fused
+    program sees one uniform geometry regardless of ring imbalance.
+    """
+    import numpy as np
+
+    E_pad = pad_entities or max(int(b.entity_idx.shape[0]) for b in shard_blocks)
+    n_max = int(shard_blocks[0].features.shape[1])
+    d = int(shard_blocks[0].features.shape[2])
+
+    def pad(b):
+        if any(sb.col_map is not None for sb in shard_blocks):
+            raise ValueError("stack_shard_blocks: projected blocks unsupported")
+        if b.features.shape[1:] != (n_max, d):
+            raise ValueError(
+                f"stack_shard_blocks: shard geometry mismatch "
+                f"{b.features.shape[1:]} vs {(n_max, d)}"
+            )
+        k = E_pad - int(b.entity_idx.shape[0])
+        return EntityBlock(
+            entity_idx=np.pad(np.asarray(b.entity_idx), (0, k), constant_values=-1),
+            features=np.pad(np.asarray(b.features), ((0, k), (0, 0), (0, 0))),
+            label=np.pad(np.asarray(b.label), ((0, k), (0, 0))),
+            weight=np.pad(np.asarray(b.weight), ((0, k), (0, 0))),
+            sample_index=np.pad(
+                np.asarray(b.sample_index), ((0, k), (0, 0)), constant_values=-1
+            ),
+            train_mask=np.pad(np.asarray(b.train_mask), (0, k)),
+        )
+
+    padded = [pad(b) for b in shard_blocks]
+    return EntityBlock(
+        entity_idx=jnp.stack([jnp.asarray(b.entity_idx) for b in padded]),
+        features=jnp.stack([jnp.asarray(b.features) for b in padded]),
+        label=jnp.stack([jnp.asarray(b.label) for b in padded]),
+        weight=jnp.stack([jnp.asarray(b.weight) for b in padded]),
+        sample_index=jnp.stack([jnp.asarray(b.sample_index) for b in padded]),
+        train_mask=jnp.stack([jnp.asarray(b.train_mask) for b in padded]),
+    )
+
+
+def game_entity_sharded_train_step(
+    mesh: Mesh,
+    fixed_objective: GLMObjective,
+    re_objective: GLMObjective,
+    fe_config: OptimizerConfig,
+    re_config: OptimizerConfig,
+    re_solver: str = "newton",
+):
+    """The whole-program entity-sharded GAME pass: RE coefficient store and
+    entity blocks carry a leading SHARD axis partitioned over the mesh's
+    data axis, so every entity's block solve runs on the device that owns
+    its shard (parallel/entity_shard.py assignment) and the coefficient
+    table is genuinely distributed — (S, E_s, d) with each (E_s, d) slab
+    resident on one device, not replicated.
+
+    Cross-device exchange happens exactly where the coordinate path merges
+    scores/residuals: the flat-batch RE score gather reads the sharded
+    table through a reshape (XLA inserts the one all-gather), and the FE
+    residual gather by ``sample_index`` pulls the rows-sharded margins to
+    each shard's blocks. The per-shard coefficient scatter is the same
+    drop-mode discipline as the single-device program, vmapped over the
+    shard axis — it updates each slab in place, preserving the sharding.
+
+    Inputs (see ``place``):
+      w_fixed           (d,)                 replicated
+      re_coefs          (S, E_s, d_re)       P('data') — shard slabs
+      fe_batch          rows                 P('data')
+      re_block          (S, E_b, n_max, …)   P('data') — stack_shard_blocks
+      re_features_flat  (n, d_re)            P('data')
+      re_shard_ids      (n,)                 P('data') — owning shard / -1
+      re_local_ids      (n,)                 P('data') — local entity index
+
+    Uniform geometry required: every shard's block must share
+    (E_b, n_max, d) — pad through :func:`stack_shard_blocks`. Projected
+    blocks are unsupported (col_map is content-defined per block).
+    """
+    import dataclasses
+
+    fixed_objective = dataclasses.replace(fixed_objective, use_pallas=False)
+    re_objective = dataclasses.replace(re_objective, use_pallas=False)
+    if fixed_objective.l1_weight > 0.0 or re_objective.l1_weight > 0.0:
+        raise ValueError(
+            "game_entity_sharded_train_step solves smooth objectives; use "
+            "the coordinate-descent path for L1/elastic-net"
+        )
+    if re_solver not in ("newton", "lbfgs"):
+        raise ValueError(f"unknown re_solver {re_solver!r}")
+
+    def step(
+        w_fixed: Array,
+        re_coefs: Array,  # (S, E_s, d_re)
+        fe_batch: LabeledBatch,
+        re_block: EntityBlock,  # leading shard axis
+        re_features_flat: Array,  # (n, d_re)
+        re_shard_ids: Array,  # (n,)
+        re_local_ids: Array,  # (n,)
+    ):
+        S, E_s = re_coefs.shape[0], re_coefs.shape[1]
+
+        def re_scores_of(coefs):
+            # Flat gather through the sharded table: reshape to (S*E_s, d)
+            # and index by shard*E_s + local. XLA lowers this to the one
+            # all-gather of the (small) coefficient slabs per score merge.
+            valid = re_shard_ids >= 0
+            idx = jnp.maximum(re_shard_ids, 0) * E_s + jnp.maximum(re_local_ids, 0)
+            w = coefs.reshape(S * E_s, -1)[idx]
+            return jnp.where(valid, jnp.sum(re_features_flat * w, axis=-1), 0.0)
+
+        fe_res = minimize_lbfgs_margin(
+            fixed_objective,
+            fe_batch.add_scores_to_offsets(re_scores_of(re_coefs)),
+            w_fixed,
+            fe_config,
+        )
+        w_fixed_new = fe_res.w
+
+        fe_scores = fe_batch.margins(w_fixed_new)
+        # (S, E_b, n_max) residual offsets: gather rows-sharded margins into
+        # shard-sharded blocks (the second cross-device exchange).
+        safe = jnp.maximum(re_block.sample_index, 0)
+        offs = jnp.where(re_block.sample_index >= 0, fe_scores[safe], 0.0)
+
+        def solve_one(feat, lab, wt, off, w_init):
+            lb = LabeledBatch(lab, feat, off, wt)
+            if re_solver == "newton":
+                res = minimize_newton(re_objective, lb, w_init, re_config)
+            else:
+                res = minimize_lbfgs_margin(re_objective, lb, w_init, re_config)
+            return res.w, res.evals
+
+        def shard_solve(coefs_s, block_idx, feat, lab, wt, off_s, mask):
+            # One shard's solves — device-local under the 'data' partition.
+            w_init = coefs_s[jnp.maximum(block_idx, 0)]
+            w_new, evals = jax.vmap(solve_one)(feat, lab, wt, off_s, w_init)
+            w_new = jnp.where(mask[:, None], w_new, w_init)
+            # Same drop-mode scatter discipline as the single-device program:
+            # -1 padding rows route to the out-of-range filler slot E_s.
+            slot = jnp.where(block_idx >= 0, block_idx, E_s)
+            coefs_out = coefs_s.at[slot].set(w_new, mode="drop")
+            visits = jnp.sum(evals * jnp.sum((wt > 0).astype(jnp.int32), axis=1))
+            return coefs_out, visits
+
+        re_coefs_new, shard_visits = jax.vmap(shard_solve)(
+            re_coefs,
+            re_block.entity_idx,
+            re_block.features,
+            re_block.label,
+            re_block.weight,
+            offs,
+            re_block.train_mask,
+        )
+
+        total_scores = fe_scores + re_scores_of(re_coefs_new)
+        return (
+            w_fixed_new,
+            re_coefs_new,
+            total_scores,
+            fe_res.evals,
+            jnp.sum(shard_visits),
+        )
+
+    dp = dp_axes(mesh)
+    repl = NamedSharding(mesh, P())
+    rows = NamedSharding(mesh, P(dp))
+    rows2d = NamedSharding(mesh, P(dp, None))
+    shards1 = NamedSharding(mesh, P(dp))
+    shards2 = NamedSharding(mesh, P(dp, None))
+    shards3 = NamedSharding(mesh, P(dp, None, None))
+    shards4 = NamedSharding(mesh, P(dp, None, None, None))
+
+    def place(
+        w_fixed, re_coefs, fe_batch, re_block, re_features_flat,
+        re_shard_ids, re_local_ids,
+    ):
+        put = jax.device_put
+        feats = fe_batch.features
+        if isinstance(feats, SparseFeatures):
+            feats = SparseFeatures(
+                put(feats.indices, rows2d), put(feats.values, rows2d), feats.dim
+            )
+        else:
+            feats = put(feats, rows2d)
+        fe = LabeledBatch(
+            label=put(fe_batch.label, rows),
+            features=feats,
+            offset=put(fe_batch.offset, rows),
+            weight=put(fe_batch.weight, rows),
+            uid=None,
+        )
+        rb = EntityBlock(
+            entity_idx=put(re_block.entity_idx, shards2),
+            features=put(re_block.features, shards4),
+            label=put(re_block.label, shards3),
+            weight=put(re_block.weight, shards3),
+            sample_index=put(re_block.sample_index, shards3),
+            train_mask=put(re_block.train_mask, shards2),
+        )
+        return (
+            put(w_fixed, repl),
+            put(re_coefs, shards3),
+            fe,
+            rb,
+            put(re_features_flat, rows2d),
+            put(re_shard_ids, rows),
+            put(re_local_ids, rows),
+        )
+
+    return (
+        jax.jit(step, out_shardings=(repl, shards1, rows, repl, repl)),
+        place,
+    )

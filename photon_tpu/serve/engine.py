@@ -95,6 +95,12 @@ class ServeConfig:
     # monitoring window closed) this many seconds after promote(). <= 0
     # keeps the parent pinned until the next promote/rollback.
     promotion_settle_s: float = 300.0
+    # Multi-chip serving: split every dense hot table into this many
+    # entity shards laid out over the device mesh (same consistent-hash
+    # plan the sharded trainer uses — parallel/entity_shard.py). None =
+    # single-device tables. Scores merge with the one all-gather XLA
+    # inserts for the slot gather against the sharded table.
+    device_shards: Optional[int] = None
 
 
 class _Breaker:
@@ -239,6 +245,7 @@ class ServingEngine:
                 # Floor: one batch's unique entities always fit resident.
                 min_hot_rows=self.max_batch,
                 partition=self._partition,
+                device_shards=self.config.device_shards,
             )
             store.warm_uploads(self.max_batch)
             transformer = GameTransformer(store.scoring_model())
@@ -446,7 +453,11 @@ class ServingEngine:
             faults.check("serve.score")
             batch = self._assemble(requests, state.store)
             batch = pad_game_batch(batch, bucket_dim(n), xp=np)
-            dev = jax.device_put(batch)
+            # Sharded hot tables live on a mesh: replicate the batch over
+            # it so the jitted scorer sees consistent placements (a plain
+            # device_put would commit to device 0 and fail the jit's
+            # incompatible-devices check against mesh-resident tables).
+            dev = jax.device_put(batch, state.store.batch_sharding)
             scores = state.transformer.transform(
                 dev, model=state.store.scoring_model()
             )

@@ -63,6 +63,8 @@ logger = logging.getLogger("photon_tpu")
 
 _scatter_rows = None
 
+_SHARD_AXIS = "data"  # mesh axis name for device-sharded hot tables
+
 
 @dataclasses.dataclass
 class StorePartition:
@@ -186,10 +188,42 @@ class _ReGroup:
     # entity index to its compacted host row (-1 = row absent host-side).
     owned: Optional[np.ndarray] = None
     compact_of: Optional[np.ndarray] = None
+    # Device-shard state (multi-chip serving): the hot table is laid out as
+    # S contiguous per-shard segments of ``shard_cap`` rows, sharded over
+    # the device mesh's data axis so each segment is resident on the device
+    # the training side trained it on (parallel/entity_shard.py — the same
+    # plan, same ring, same hashed keys). Pinned groups address the table
+    # through ``perm`` (entity → shard-grouped slot); unpinned groups run
+    # one SlotLru per segment (``shard_lrus``) over disjoint slot ranges.
+    shard_plan: Optional[object] = None
+    shard_cap: Optional[int] = None
+    perm: Optional[np.ndarray] = None  # pinned: (E,) entity -> slot
+    shard_lrus: Optional[List[SlotLru]] = None
 
     @property
     def row_bytes(self) -> int:
         return sum(4 * c.shape[1] for c in self.host_coefs.values())
+
+    def _lru_for(self, entity: int) -> SlotLru:
+        if self.shard_lrus is not None:
+            return self.shard_lrus[int(self.shard_plan.shard_of[entity])]
+        return self.lru
+
+    def slot_get(self, entity: int) -> Optional[int]:
+        return self._lru_for(entity).get(entity)
+
+    def slot_peek(self, entity: int) -> Optional[int]:
+        return self._lru_for(entity).peek(entity)
+
+    def slot_claim(self, entity: int, protected) -> int:
+        return self._lru_for(entity).claim(entity, protected)
+
+    def resident_count(self) -> int:
+        if self.pinned:
+            return self.num_entities
+        if self.shard_lrus is not None:
+            return sum(len(l) for l in self.shard_lrus)
+        return len(self.lru)
 
 
 @dataclasses.dataclass
@@ -247,11 +281,40 @@ class HotColdEntityStore:
         hot_bytes: int = 64 << 20,
         min_hot_rows: int = 64,
         partition: Optional[StorePartition] = None,
+        device_shards: Optional[int] = None,
     ):
         import jax
 
         self._entity_indexes = dict(entity_indexes or {})
         self._partition = partition
+        # Multi-chip mode: split every dense hot table into ``device_shards``
+        # entity shards (consistent-hash plan shared with training) and lay
+        # them out over the device mesh's data axis. The mesh spans the
+        # largest device count that divides the shard count, so per-shard
+        # segments chunk evenly; a single-device backend degrades to S
+        # segments on one chip (same slot discipline, no mesh surprises).
+        self._device_shards: Optional[int] = None
+        self._mesh = None
+        self._table_sharding = None
+        self._replicated_sharding = None
+        if device_shards:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            S = int(device_shards)
+            devs = jax.devices()
+            n_use = max(
+                k for k in range(1, min(S, len(devs)) + 1) if S % k == 0
+            )
+            self._device_shards = S
+            self._mesh = Mesh(
+                np.asarray(devs[:n_use]), (_SHARD_AXIS,)
+            )
+            self._table_sharding = NamedSharding(
+                self._mesh, PartitionSpec(_SHARD_AXIS)
+            )
+            self._replicated_sharding = NamedSharding(
+                self._mesh, PartitionSpec()
+            )
         self._groups: Dict[str, _ReGroup] = {}
         self._proj_groups: Dict[str, _ProjGroup] = {}
         self._re_subs: Dict[str, RandomEffectModel] = {}
@@ -327,6 +390,44 @@ class HotColdEntityStore:
                 reg.gauge(
                     "serve_store_owned_entities", re_type=re_type
                 ).set(owned_count)
+            shard_plan = None
+            shard_cap = None
+            perm = None
+            shard_lrus = None
+            if self._device_shards:
+                from photon_tpu.parallel.entity_shard import build_shard_plan
+
+                shard_plan = build_shard_plan(
+                    E,
+                    self._device_shards,
+                    entity_index=self._entity_indexes.get(re_type),
+                )
+                S = shard_plan.n_shards
+                if pinned:
+                    # Shard-grouped full residency: segment s holds shard
+                    # s's entities at their local indices, padded to the
+                    # largest shard so segments chunk evenly over the mesh.
+                    shard_cap = max(int(shard_plan.counts.max()), 1)
+                    cap = S * shard_cap
+                    perm = (
+                        shard_plan.shard_of.astype(np.int64) * shard_cap
+                        + shard_plan.local_of
+                    ).astype(np.int32)
+                else:
+                    # Budget split evenly across segments, floored at
+                    # min_hot_rows EACH: one batch's entities may all hash
+                    # to a single shard, and its segment alone must hold
+                    # them resident simultaneously.
+                    shard_cap = max(int(min_hot_rows), cap // S)
+                    cap = S * shard_cap
+                    shard_lrus = [
+                        SlotLru(
+                            shard_cap,
+                            on_demote=self._demote_counter(re_type),
+                            base=s * shard_cap,
+                        )
+                        for s in range(S)
+                    ]
             group = _ReGroup(
                 re_type=re_type,
                 coord_ids=[cid for cid, _ in subs],
@@ -336,23 +437,40 @@ class HotColdEntityStore:
                 pinned=pinned,
                 owned=owned,
                 compact_of=compact_of,
+                shard_plan=shard_plan,
+                shard_cap=shard_cap,
+                perm=perm,
+                shard_lrus=shard_lrus,
             )
             if pinned:
-                group.tables = {
-                    cid: jax.device_put(host[cid]) for cid in group.coord_ids
-                }
+                if perm is not None:
+                    tabs = {}
+                    for cid in group.coord_ids:
+                        t = np.zeros(
+                            (group.capacity, host[cid].shape[1]), np.float32
+                        )
+                        t[perm] = host[cid]
+                        tabs[cid] = jax.device_put(t, self._table_sharding)
+                    group.tables = tabs
+                else:
+                    group.tables = {
+                        cid: jax.device_put(host[cid])
+                        for cid in group.coord_ids
+                    }
             else:
                 group.tables = {
                     cid: jax.device_put(
                         np.zeros(
                             (group.capacity, host[cid].shape[1]), np.float32
-                        )
+                        ),
+                        self._table_sharding,
                     )
                     for cid in group.coord_ids
                 }
-                group.lru = SlotLru(
-                    group.capacity, on_demote=self._demote_counter(re_type)
-                )
+                if shard_lrus is None:
+                    group.lru = SlotLru(
+                        group.capacity, on_demote=self._demote_counter(re_type)
+                    )
             self._groups[re_type] = group
             for cid, s in subs:
                 self._re_subs[cid] = s
@@ -501,6 +619,33 @@ class HotColdEntityStore:
     # -- residency ---------------------------------------------------------
 
     @property
+    def device_shards(self) -> Optional[int]:
+        """Hot-table shard count in multi-chip mode (None = single-table)."""
+        return self._device_shards
+
+    @property
+    def mesh(self):
+        """The device mesh sharded hot tables live on (None = unsharded).
+        The engine replicates request batches over it so the jitted scorer
+        sees consistent placements; the score merge is the one all-gather
+        XLA inserts for the slot gather against the sharded table."""
+        return self._mesh
+
+    @property
+    def batch_sharding(self):
+        """Replicated NamedSharding for request batches (None = unsharded)."""
+        return self._replicated_sharding
+
+    def shard_snapshot(self, re_type: str) -> Optional[dict]:
+        """The entity→shard assignment identity for ``re_type`` — comparable
+        against ``EntityShardPlan.snapshot()`` from the training side (tests
+        assert train and serve derive the same assignment from the ring)."""
+        group = self._groups.get(re_type)
+        if group is None or group.shard_plan is None:
+            return None
+        return group.shard_plan.snapshot()
+
+    @property
     def re_types(self) -> List[str]:
         """RE types under hot/cold management (table-swapped at scoring)."""
         return list(self._groups)
@@ -559,7 +704,15 @@ class HotColdEntityStore:
                 re_type, group.owned, group.compact_of, ids
             )
         if group.pinned:
-            return ids.astype(np.int32)
+            ids = ids.astype(np.int32)
+            if group.perm is None:
+                return ids
+            # Device-sharded pinned table: slots are shard-grouped, so the
+            # passthrough routes through the entity→slot permutation.
+            out = np.full(len(ids), -1, np.int32)
+            pos = ids >= 0
+            out[pos] = group.perm[ids[pos]]
+            return out
 
         reg = registry()
         slots = np.empty(len(ids), np.int32)
@@ -571,7 +724,7 @@ class HotColdEntityStore:
             if e < 0:
                 slots[j] = -1
                 continue
-            slot = group.lru.get(e)
+            slot = group.slot_get(e)
             if slot is not None:
                 if e not in in_use and e not in misses:
                     hits += 1
@@ -697,11 +850,16 @@ class HotColdEntityStore:
         # Demotes the least-recently-used entity that is NOT part of the
         # current batch. capacity ≥ max batch size guarantees a victim.
         try:
-            return group.lru.claim(entity, in_use)
+            return group.slot_claim(entity, in_use)
         except RuntimeError:
+            what = (
+                f"shard segment capacity {group.shard_cap}"
+                if group.shard_lrus is not None
+                else f"capacity {group.capacity}"
+            )
             raise RuntimeError(
                 f"hot store for {group.re_type!r} exhausted: batch has more "
-                f"unique entities than capacity {group.capacity}"
+                f"unique entities than {what}"
             ) from None
 
     def _upload(self, group: _ReGroup, entities: List[int]) -> None:
@@ -711,7 +869,7 @@ class HotColdEntityStore:
         m = len(entities)
         m_b = bucket_dim(m)
         idx = np.full(m_b, group.capacity, np.int32)
-        idx[:m] = [group.lru.peek(e) for e in entities]
+        idx[:m] = [group.slot_peek(e) for e in entities]
         ent = np.asarray(entities, np.int64)
         if group.compact_of is not None:
             # Only servable entities reach here (resolve masked the rest),
@@ -1004,6 +1162,10 @@ class HotColdEntityStore:
         new._re_subs = self._re_subs
         new._proj_groups = self._proj_groups
         new._partition = self._partition
+        new._device_shards = self._device_shards
+        new._mesh = self._mesh
+        new._table_sharding = self._table_sharding
+        new._replicated_sharding = self._replicated_sharding
         base = dict(self._base)
         for cid, means in fixed.items():
             sub = base[cid]
@@ -1054,6 +1216,9 @@ class HotColdEntityStore:
                 pinned=group.pinned,
                 owned=group.owned,
                 compact_of=group.compact_of,
+                shard_plan=group.shard_plan,
+                shard_cap=group.shard_cap,
+                perm=group.perm,
             )
             if group.pinned:
                 tables: Dict[str, object] = {}
@@ -1068,8 +1233,12 @@ class HotColdEntityStore:
                     m_b = bucket_dim(m)
                     # capacity == num_entities when pinned: the filler
                     # index is out of range and drops, like _upload's.
+                    # Device-sharded tables are addressed through the
+                    # entity→slot permutation (shard-grouped layout).
                     pad_idx = np.full(m_b, group.capacity, np.int32)
-                    pad_idx[:m] = idx
+                    pad_idx[:m] = (
+                        group.perm[idx] if group.perm is not None else idx
+                    )
                     pad_rows = np.zeros((m_b, rows.shape[1]), np.float32)
                     pad_rows[:m] = rows
                     tables[cid] = _oom_contained(
@@ -1084,13 +1253,24 @@ class HotColdEntityStore:
                     cid: jax.device_put(
                         np.zeros(
                             (g2.capacity, host2[cid].shape[1]), np.float32
-                        )
+                        ),
+                        self._table_sharding,
                     )
                     for cid in group.coord_ids
                 }
-                g2.lru = SlotLru(
-                    g2.capacity, on_demote=self._demote_counter(re_type)
-                )
+                if group.shard_lrus is not None:
+                    g2.shard_lrus = [
+                        SlotLru(
+                            group.shard_cap,
+                            on_demote=self._demote_counter(re_type),
+                            base=s * group.shard_cap,
+                        )
+                        for s in range(group.shard_plan.n_shards)
+                    ]
+                else:
+                    g2.lru = SlotLru(
+                        g2.capacity, on_demote=self._demote_counter(re_type)
+                    )
             groups[re_type] = g2
         new._groups = groups
         registry().counter("serve_store_delta_clones_total").inc()
@@ -1134,17 +1314,16 @@ class HotColdEntityStore:
             out[re_type] = dict(
                 entities=group.num_entities,
                 hot_capacity=group.capacity,
-                hot_resident=(
-                    group.num_entities
-                    if group.pinned
-                    else len(group.lru)
-                ),
+                hot_resident=group.resident_count(),
                 pinned=group.pinned,
                 hot_bytes=group.capacity * group.row_bytes,
             )
             if group.owned is not None:
                 out[re_type]["owned_entities"] = int(group.owned.sum())
                 out[re_type]["compacted_host"] = group.compact_of is not None
+            if group.shard_plan is not None:
+                out[re_type]["device_shards"] = group.shard_plan.n_shards
+                out[re_type]["shard_rows"] = group.shard_cap
         for re_type, proj in self._proj_groups.items():
             out[re_type] = dict(
                 entities=proj.num_entities,
