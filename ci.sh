@@ -561,6 +561,15 @@ run_streaming() {
     echo "== streaming: feedback spool -> delta micro-generations -> promote =="
     JAX_PLATFORMS=cpu python bench.py --streaming-soak
     echo "   streaming-soak smoke OK"
+    # Sharded freshness plane (ISSUE 17): 2 entity-hash-routed shard
+    # workers over live-spooled traffic — composed model bit-identical to
+    # the single updater, zero post-warmup retraces per shard, concurrent
+    # flock'd publishes rebasing to one linear lineage. (The >=3x scaling
+    # bar is asserted by the full `bench.py --updater-shard-ab`, not in
+    # CI — shared boxes are too noisy to gate on a throughput ratio.)
+    echo "== streaming: 2-shard updater A/B (parity + retrace + lineage) =="
+    JAX_PLATFORMS=cpu python bench.py --updater-shard-ab --shard-smoke
+    echo "   updater-shard-ab smoke OK"
 }
 
 run_exhaustion() {
@@ -656,6 +665,14 @@ run_install() {
         PYTHONPATH="$parent_site" "$tmp/venv/bin/$cmd" --help > /dev/null
         echo "   $cmd --help OK"
     done
+    # The sharded freshness plane must be reachable from the installed
+    # entry point, not just the module: --updater-shards (and the
+    # materializing router switch) are part of the CLI contract.
+    PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-game-streaming" \
+        --help | grep -q -- "--updater-shards"
+    PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-game-streaming" \
+        --help | grep -q -- "--route-spool"
+    echo "   photon-tpu-game-streaming exposes --updater-shards/--route-spool OK"
     rm -rf "$tmp"
 }
 

@@ -31,9 +31,11 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import copy
 import json
 import logging
 import os
+import threading
 from typing import Dict
 
 from photon_tpu.cli.common import (
@@ -74,6 +76,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated coordinate ids to keep fixed "
                         "(typically the fixed effects: micro-batches are "
                         "too small to re-fit the global model)")
+    p.add_argument("--updater-shards", type=int, default=1,
+                   help="total updater shards in the freshness plane: "
+                        "records route to shards by entity hash on the "
+                        "serving ring, so each shard owns a disjoint entity "
+                        "subset and publishes commuting delta layers")
+    p.add_argument("--shard-index", type=int, default=None,
+                   help="run ONLY this shard worker (one process per shard, "
+                        "the fleet layout); default with --updater-shards>1 "
+                        "runs every shard as a thread in this process")
+    p.add_argument("--route-re-type", default=None,
+                   help="random-effect type whose entity id records route "
+                        "on (default: route_key's deterministic fallback "
+                        "order, same as serving)")
+    p.add_argument("--route-spool", action="store_true",
+                   help="materialize the shard partition: a router thread "
+                        "splits each sealed segment once into per-shard "
+                        "sub-spools under <spool-dir>/.shards/ and workers "
+                        "consume only their own — aggregate throughput then "
+                        "scales with shard count instead of plateauing at "
+                        "the read-side routing scan (threads mode only; a "
+                        "--shard-index fleet process should point "
+                        "--spool-dir at its pre-routed shard dir instead)")
     p.add_argument("--no-delta", action="store_true",
                    help="publish full generations instead of delta layers")
     p.add_argument("--full-every", type=int, default=0,
@@ -147,36 +171,128 @@ def run(args) -> Dict:
             "root must come from a game_training run"
         )
 
-    updater = StreamingUpdater(
-        StreamingUpdaterConfig(
-            publish_root=args.publish_root,
-            spool_dir=args.spool_dir,
-            task=task,
-            coordinate_configs=coord_configs,
-            update_sequence=update_sequence,
-            cadence_s=args.cadence,
-            min_records=args.min_records,
-            max_segments_per_cycle=args.max_segments,
-            locked_coordinates=[
-                s for s in args.lock_coordinates.split(",") if s
-            ],
-            delta_artifacts=not args.no_delta,
-            full_every=args.full_every,
-            holdout_fraction=args.holdout_fraction,
-            evaluators=list(args.evaluators),
-            metric_tolerance=args.metric_tolerance,
-            norm_drift_bound=args.norm_drift_bound,
-            num_iterations=args.coordinate_descent_iterations,
-            re_convergence_tol=args.re_convergence_tol,
-        ),
-        index_maps,
-        entity_indexes,
-    )
+    num_shards = max(1, int(args.updater_shards))
+    route_spool = bool(getattr(args, "route_spool", False)) and num_shards > 1
+    if route_spool and args.shard_index is not None:
+        raise SystemExit(
+            "--route-spool runs the router in-process (threads mode); a "
+            "fleet shard process should point --spool-dir at its "
+            "pre-routed <spool-dir>/.shards/shard-<k> directory instead"
+        )
+    if route_spool and any(c in args.spool_dir for c in "*?["):
+        raise SystemExit(
+            "--route-spool needs a single literal --spool-dir (the router "
+            "splits one raw spool); multi-spool globs use read-side "
+            "routing, which needs no router"
+        )
+    routed_root = os.path.join(args.spool_dir, ".shards")
+    if args.shard_index is not None:
+        # One process per shard — the fleet layout. Siblings run elsewhere
+        # against the same publish root; the flock'd publish tail and the
+        # per-shard manifest cursors are the only coordination.
+        shard_indexes = [int(args.shard_index)]
+    else:
+        shard_indexes = list(range(num_shards))
+
+    def make_updater(shard_index: int) -> StreamingUpdater:
+        # Each worker gets its OWN artifact copies (the process-per-shard
+        # semantics, emulated in threads): interning is then shard-local,
+        # and disjoint routing means no entity id is ever interned by two
+        # workers — artifacts stay string-keyed and composable.
+        imaps = copy.deepcopy(index_maps)
+        eidxs = copy.deepcopy(entity_indexes)
+        from photon_tpu.stream.shard_router import shard_spool_dir
+
+        spool_dir = (
+            shard_spool_dir(routed_root, shard_index)
+            if route_spool else args.spool_dir
+        )
+        return StreamingUpdater(
+            StreamingUpdaterConfig(
+                publish_root=args.publish_root,
+                spool_dir=spool_dir,
+                task=task,
+                coordinate_configs=coord_configs,
+                update_sequence=update_sequence,
+                cadence_s=args.cadence,
+                min_records=args.min_records,
+                max_segments_per_cycle=args.max_segments,
+                locked_coordinates=[
+                    s for s in args.lock_coordinates.split(",") if s
+                ],
+                delta_artifacts=not args.no_delta,
+                full_every=args.full_every,
+                holdout_fraction=args.holdout_fraction,
+                evaluators=list(args.evaluators),
+                metric_tolerance=args.metric_tolerance,
+                norm_drift_bound=args.norm_drift_bound,
+                num_iterations=args.coordinate_descent_iterations,
+                re_convergence_tol=args.re_convergence_tol,
+                num_shards=num_shards,
+                shard_index=shard_index,
+                route_re_type=args.route_re_type,
+                pre_routed=route_spool,
+            ),
+            imaps if num_shards > 1 else index_maps,
+            eidxs if num_shards > 1 else entity_indexes,
+        )
+
+    updaters = [make_updater(k) for k in shard_indexes]
+    router_stop = threading.Event()
+    router_thread = None
+    if route_spool:
+        from photon_tpu.stream.shard_router import route_segments
+
+        # Route everything already sealed BEFORE workers start (so bounded
+        # --max-cycles runs see their traffic), then keep splitting new
+        # segments as they seal. Routing is idempotent, so a crash or
+        # restart anywhere in this loop is harmless.
+        def _route_loop():
+            while not router_stop.is_set():
+                try:
+                    route_segments(
+                        args.spool_dir, routed_root, num_shards,
+                        route_re_type=args.route_re_type,
+                    )
+                except Exception:  # noqa: BLE001 — retried next pass
+                    logger.exception("spool routing pass failed")
+                router_stop.wait(min(float(args.cadence), 1.0))
+
+        route_segments(
+            args.spool_dir, routed_root, num_shards,
+            route_re_type=args.route_re_type,
+        )
+        router_thread = threading.Thread(
+            target=_route_loop, name="spool-router", daemon=True
+        )
+        router_thread.start()
+    cycles = 0
     try:
-        cycles = updater.run_forever(max_cycles=args.max_cycles)
+        if len(updaters) == 1:
+            cycles = updaters[0].run_forever(max_cycles=args.max_cycles)
+        else:
+            threads = [
+                threading.Thread(
+                    target=u.run_forever,
+                    kwargs={"max_cycles": args.max_cycles},
+                    name=f"updater-shard-{u.config.shard_index}",
+                    daemon=True,
+                )
+                for u in updaters
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            cycles = sum(u.stats()["cycles"] for u in updaters)
     except KeyboardInterrupt:
-        updater.stop()
-        cycles = updater.stats()["cycles"]
+        for u in updaters:
+            u.stop()
+        cycles = sum(u.stats()["cycles"] for u in updaters)
+    finally:
+        router_stop.set()
+        if router_thread is not None:
+            router_thread.join(timeout=5.0)
     finalize_run_report("game_streaming", path=args.telemetry_out)
     if exporter is not None:
         try:
@@ -185,12 +301,22 @@ def run(args) -> Dict:
         except Exception:  # noqa: BLE001 — export is best-effort at exit
             logger.exception("final OTLP export failed")
         uninstall_exporter()
-    stats = updater.stats()
-    return {
+    all_stats = [u.stats() for u in updaters]
+    out = {
         "cycles": cycles,
-        "publishes": stats["publishes"],
-        "consumedThrough": stats["consumed_through"],
+        "publishes": sum(s["publishes"] for s in all_stats),
+        "consumedThrough": max(s["consumed_through"] for s in all_stats),
     }
+    if num_shards > 1:
+        out["shards"] = {
+            str(u.config.shard_index): {
+                "cycles": s["cycles"],
+                "publishes": s["publishes"],
+                "consumedThrough": s["consumed_through"],
+            }
+            for u, s in zip(updaters, all_stats)
+        }
+    return out
 
 
 def main(argv=None):

@@ -11,11 +11,14 @@ role) and are structured for interop with reference tooling.
 
 from __future__ import annotations
 
+import collections
+import contextlib
 import dataclasses
 import hashlib
 import json
 import logging
 import os
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -441,7 +444,15 @@ def resolve_delta_chain(
     are looked up as siblings under ``publish_root`` (default: the
     generation's own parent directory). Raises FileNotFoundError when a
     referenced base is missing, ValueError on a cycle or over-deep chain —
-    the gate turns either into a refusal, never a published generation."""
+    the gate turns either into a refusal, never a published generation.
+
+    Interleaved shard publishes need no special casing here: each sharded
+    streaming publish rebases onto the ``LATEST`` of its moment under
+    :func:`publish_lock`, so the lineage stays a single base chain whose
+    consecutive layers may come from DIFFERENT shards. Because shard layers
+    are row-disjoint (:func:`layers_commute`), the row-overwrite resolve is
+    order-independent across them — the composed model is bit-identical to
+    the single-updater ordering of the same cycles."""
     publish_root = publish_root or os.path.dirname(
         os.path.abspath(model_dir.rstrip("/"))
     )
@@ -474,6 +485,46 @@ def resolve_delta_chain(
         cur = cand
 
 
+def delta_row_ids(model_dir: str) -> Dict[str, set]:
+    """Per-coordinate model ids (entity id strings) carried by one DELTA
+    layer: ``{cid: {modelId, ...}}``. The ids are exactly the strings the
+    routing ring hashes (``serve/store._owned_mask`` hashes the same ones),
+    so two shard workers' layers are row-disjoint iff these sets are
+    disjoint per coordinate. A full generation returns {} — it is not a
+    layer and participates in no commutation question."""
+    if delta_info(model_dir) is None:
+        return {}
+    meta = read_model_metadata(model_dir)
+    out: Dict[str, set] = {}
+    for cid, info in meta["coordinates"].items():
+        if info.get("type") == "fixed":
+            # A layer carrying a retrained FE never commutes with anything.
+            out[cid] = {"__fixed__"}
+            continue
+        cdir = os.path.join(model_dir, RANDOM_DIR, cid)
+        ids = set()
+        for path in _coefficient_files(cdir):
+            for rec in _coefficient_records(path):
+                ids.add(rec["modelId"])
+        out[cid] = ids
+    return out
+
+
+def layers_commute(dir_a: str, dir_b: str) -> bool:
+    """True iff two delta layers touch row-disjoint entity sets in every
+    coordinate (and neither retrains the fixed effect). Row-overwrite
+    application (:func:`_apply_delta_layer`) of disjoint row sets is
+    order-independent, so any interleaving of such layers over a common
+    ancestry resolves to the same composed model — the invariant that lets
+    N entity-hash-routed updater shards publish concurrently without a
+    total order on their training cycles."""
+    rows_a, rows_b = delta_row_ids(dir_a), delta_row_ids(dir_b)
+    for cid in set(rows_a) & set(rows_b):
+        if rows_a[cid] & rows_b[cid]:
+            return False
+    return True
+
+
 def _resolved_coordinate_records(
     model_dir: str, publish_root: Optional[str] = None
 ) -> Tuple[Dict[str, dict], Dict[str, dict]]:
@@ -492,7 +543,7 @@ def _resolved_coordinate_records(
             cdir = os.path.join(layer, sub, cid)
             per = records.setdefault(cid, {})
             for path in _coefficient_files(cdir):
-                for rec in read_avro_records(path):
+                for rec in _coefficient_records(path):
                     per[rec["modelId"]] = rec
     return coordinates, records
 
@@ -540,7 +591,7 @@ def coordinate_norms(model_dir: str, resolve_deltas: bool = True) -> Dict[str, d
 
         def _iter(cdir=cdir):
             for path in _coefficient_files(cdir):
-                yield from read_avro_records(path)
+                yield from _coefficient_records(path)
 
         out[cid] = _norms_over_records(_iter())
     return out
@@ -823,6 +874,30 @@ def allocate_generation(publish_root: str, prefix: str = "gen-") -> str:
     return name
 
 
+@contextlib.contextmanager
+def publish_lock(publish_root: str):
+    """Exclusive flock serializing the save→manifest→gate→flip tail of a
+    publish against every other holder of the same publish root.
+
+    ``allocate_generation`` already makes generation NAMES race-safe; this
+    lock makes generation LINEAGE race-safe. Concurrent shard workers that
+    each resolved the same parent at cycle start would otherwise both flip
+    ``LATEST`` with a delta based on that stale parent, dropping the other
+    worker's rows from the resolved chain. Under the lock each publisher
+    re-reads ``LATEST`` and rebases its (row-disjoint, therefore commuting)
+    layer onto the true predecessor — chains stay linear no matter how
+    cycles interleave. Held for file IO only, never for a solve."""
+    os.makedirs(publish_root, exist_ok=True)
+    with open(os.path.join(publish_root, ".streaming-publish.lock"), "a") as lockf:
+        try:
+            import fcntl
+
+            fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
+        except ImportError:  # non-POSIX: best-effort, single-writer only
+            pass
+        yield
+
+
 def save_delta_model(
     model: GameModel,
     changed_entities: Dict[str, np.ndarray],
@@ -977,7 +1052,7 @@ def read_delta_rows(
             cdir = os.path.join(model_dir, FIXED_DIR, cid)
             recs = []
             for path in _coefficient_files(cdir):
-                recs.extend(read_avro_records(path))
+                recs.extend(_coefficient_records(path))
             if len(recs) != 1:
                 raise ValueError(
                     f"delta fixed-effect {cid!r}: expected one record, "
@@ -997,7 +1072,7 @@ def read_delta_rows(
                 )
             idx, rows = [], []
             for path in _coefficient_files(cdir):
-                for rec in read_avro_records(path):
+                for rec in _coefficient_records(path):
                     e = eidx.lookup(rec["modelId"])
                     if e < 0:
                         raise ValueError(
@@ -1088,7 +1163,7 @@ def _apply_delta_layer(
             cdir = os.path.join(layer_dir, FIXED_DIR, cid)
             recs = []
             for path in _coefficient_files(cdir):
-                recs.extend(read_avro_records(path))
+                recs.extend(_coefficient_records(path))
             if len(recs) != 1:
                 raise ValueError(
                     f"delta fixed-effect {cid!r}: expected one record, "
@@ -1130,7 +1205,7 @@ def _apply_delta_layer(
             eidx = entity_indexes.setdefault(re_type, EntityIndex())
             recs = []
             for path in _coefficient_files(cdir):
-                recs.extend(read_avro_records(path))
+                recs.extend(_coefficient_records(path))
             for rec in recs:
                 eidx.intern(rec["modelId"])
             E = len(eidx)
@@ -1222,6 +1297,40 @@ def _coefficient_files(cdir: str) -> list:
     return out
 
 
+# Decoded-record cache for coefficient part files. Generation directories are
+# immutable once published (every publish allocates a fresh flock'd name), yet
+# the streaming plane re-decodes the same chain every cycle: the gate's
+# coordinate_norms resolves the parent chain, the warm start loads it again,
+# and with N shard workers in one process each re-reads the shared ancestry.
+# Python-side Avro decode dominates those walks, so cache per FILE keyed on
+# (mtime_ns, size, inode) — a rewritten or corrupted-in-place file (the gate
+# refusal tests do this) misses and is re-read. Callers treat the returned
+# records as read-only; nothing in this module mutates them.
+_COEFF_CACHE_MAX = 512
+_coeff_cache: "collections.OrderedDict" = collections.OrderedDict()
+_coeff_cache_lock = threading.Lock()
+
+
+def _coefficient_records(path: str) -> list:
+    try:
+        st = os.stat(path)
+        sig = (st.st_mtime_ns, st.st_size, st.st_ino)
+    except OSError:
+        return read_avro_records(path)
+    with _coeff_cache_lock:
+        hit = _coeff_cache.get(path)
+        if hit is not None and hit[0] == sig:
+            _coeff_cache.move_to_end(path)
+            return hit[1]
+    recs = read_avro_records(path)
+    with _coeff_cache_lock:
+        _coeff_cache[path] = (sig, recs)
+        _coeff_cache.move_to_end(path)
+        while len(_coeff_cache) > _COEFF_CACHE_MAX:
+            _coeff_cache.popitem(last=False)
+    return recs
+
+
 def read_model_metadata(model_dir: str) -> dict:
     """Model metadata with a guaranteed ``coordinates`` table: reads the
     JSON this repo writes, falling back to the reference-layout directory
@@ -1284,7 +1393,7 @@ def load_game_model(
             cdir = os.path.join(model_dir, FIXED_DIR, cid)
             recs = []
             for path in _coefficient_files(cdir):
-                recs.extend(read_avro_records(path))
+                recs.extend(_coefficient_records(path))
             if len(recs) != 1:  # Spark may write empty extra part files
                 raise ValueError(
                     f"fixed-effect coordinate {cid!r}: expected exactly one "
@@ -1310,7 +1419,7 @@ def load_game_model(
             eidx = entity_indexes.setdefault(re_type, EntityIndex())
             recs = []
             for path in _coefficient_files(cdir):
-                recs.extend(read_avro_records(path))
+                recs.extend(_coefficient_records(path))
             # First pass: intern all entity ids.
             for rec in recs:
                 eidx.intern(rec["modelId"])

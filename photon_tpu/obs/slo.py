@@ -98,15 +98,23 @@ def streaming_objectives(
     cycle_target: float = 0.95,
     staleness_threshold_s: float = 120.0,
     staleness_target: float = 0.99,
+    fe_age_threshold_s: float = 3600.0,
+    fe_age_target: float = 0.95,
 ) -> List[Objective]:
     """The updater-side SLO plane: micro-generation cycle success ratio
     plus published-model freshness — measurable with NO server running
-    (the serve-side staleness objective only ticks at promote time)."""
+    (the serve-side staleness objective only ticks at promote time) —
+    plus the locked-fixed-effect age objective. Streaming deltas never
+    retrain the FE, so its age grows monotonically between full publishes;
+    once cycles observe it past the bar the burn machinery turns sustained
+    violation into warn/page state, which is what the updater's
+    ``stream_fe_retrain_wanted`` trigger keys off."""
     return [
         Objective("update_cycle", cycle_target),
         Objective(
             "model_staleness_s", staleness_target, staleness_threshold_s, "s"
         ),
+        Objective("fe_age_s", fe_age_target, fe_age_threshold_s, "s"),
     ]
 
 
@@ -218,6 +226,17 @@ class SLOTracker:
             self.record_event(
                 "model_staleness_s", staleness_s <= obj.threshold, now=now
             )
+
+    def record_fe_age(
+        self, age_s: float, now: Optional[float] = None
+    ) -> None:
+        """One observation of the locked fixed effect's age — good while
+        under the objective's threshold. Observed once per update cycle,
+        so the multi-window burn state reflects SUSTAINED staleness, not a
+        single slow full retrain."""
+        obj = self.objectives.get("fe_age_s")
+        if obj is not None and obj.threshold is not None:
+            self.record_event("fe_age_s", age_s <= obj.threshold, now=now)
 
     # -- burn / state ------------------------------------------------------
 

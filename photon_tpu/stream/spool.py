@@ -48,6 +48,11 @@ SEGMENT_PREFIX = "segment-"
 SEALED_SUFFIX = ".jsonl"
 PART_SUFFIX = ".part"
 WRITER_LOCK = "writer.lock"
+# Sidecar reclaiming TTL-evicted joins: `evicted` lines carry the scored
+# features, `late_label` lines the label that missed the window. Never
+# listed as a segment (no SEGMENT_PREFIX) — the updater ignores it; a
+# future backfill pass re-joins the pairs and publishes a corrective delta.
+LATE_LABELS_FILE = "late-labels.jsonl"
 
 
 @dataclasses.dataclass
@@ -216,6 +221,7 @@ class FeedbackSpool:
         # FIFO so the memory cost mirrors the pending buffer's.
         self._expired: "OrderedDict[str, float]" = OrderedDict()
         self._late_logged_seq = -1  # once-per-segment late-label log guard
+        self._late_f = None  # late-labels.jsonl sidecar, opened on first use
         self._acc: Dict[str, float] = {}  # per-tenant sampling accumulator
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -287,7 +293,7 @@ class FeedbackSpool:
         dropped = 0
         while self._pending:
             first_uid = next(iter(self._pending))
-            t0, _rec = self._pending[first_uid]
+            t0, rec = self._pending[first_uid]
             over_capacity = len(self._pending) > cfg.join_capacity
             past_ttl = now - t0 > cfg.join_ttl_s
             if over_capacity or past_ttl:
@@ -295,6 +301,14 @@ class FeedbackSpool:
                 dropped += 1
                 if past_ttl:
                     self._expired[first_uid] = now
+                    # Side-spool the scored half so the eviction is
+                    # reclaimable: when its label eventually lands (the
+                    # late path below writes the other half), a backfill
+                    # pass can re-join the pair instead of losing the
+                    # example.
+                    self._spool_late_locked(
+                        {"kind": "evicted", "evictedAt": now, "record": rec}
+                    )
             else:
                 break
         expired_cap = max(cfg.join_capacity, 1024)
@@ -302,6 +316,33 @@ class FeedbackSpool:
             self._expired.popitem(last=False)
         if dropped:
             registry().counter("feedback_join_dropped_total").inc(dropped)
+
+    def late_labels_path(self) -> str:
+        return os.path.join(self.directory, LATE_LABELS_FILE)
+
+    def _spool_late_locked(self, obj: dict) -> bool:
+        """Append one JSON line to the ``late-labels.jsonl`` sidecar.
+        Best-effort by design: the sidecar reclaims data the join already
+        gave up on, so a write failure drops with a counter and must never
+        take down label ingestion (same containment contract as
+        ``_append_locked``). Lines interleave two kinds keyed by uid —
+        ``evicted`` (the scored features, written at TTL eviction) and
+        ``late_label`` (the label, written when it finally arrives) — which
+        is exactly the pair a future backfill pass re-joins."""
+        from photon_tpu.obs.metrics import registry
+
+        try:
+            self._guard.check()
+            if self._late_f is None:
+                self._late_f = open(self.late_labels_path(), "a")
+            self._late_f.write(json.dumps(obj) + "\n")
+            self._late_f.flush()
+        except Exception as exc:  # noqa: BLE001 — containment, not rethrow
+            self._guard.record(exc)
+            registry().counter("feedback_late_spool_errors_total").inc()
+            return False
+        registry().counter("feedback_late_spooled_total").inc()
+        return True
 
     def observe_label(
         self, uid: str, label: float, ts: Optional[float] = None
@@ -338,8 +379,16 @@ class FeedbackSpool:
                 if str(uid) in self._expired:
                     # The scored request WAS here; the label just missed the
                     # join window. Counted separately from never-seen uids so
-                    # the planned backfill pass has a measured denominator.
+                    # the planned backfill pass has a measured denominator —
+                    # and side-spooled so that pass has the label itself,
+                    # not just a count.
                     registry().counter("feedback_label_late_total").inc()
+                    self._spool_late_locked({
+                        "kind": "late_label",
+                        "uid": str(uid),
+                        "label": float(label),
+                        "labelTs": ts if ts is not None else time.time(),
+                    })
                     if self._late_logged_seq != self._seq:
                         self._late_logged_seq = self._seq
                         logger.warning(
@@ -466,6 +515,12 @@ class FeedbackSpool:
                 except OSError:
                     pass
                 self._part = None
+            if self._late_f is not None:
+                try:
+                    self._late_f.close()
+                except OSError:
+                    pass
+                self._late_f = None
         try:
             self._lockf.close()
         except OSError:
@@ -479,6 +534,7 @@ class FeedbackSpool:
                 "active_records": self._part_records if self._part else 0,
                 "next_seq": self._seq,
                 "sealed": len(sealed_segments(self.directory)),
+                "late_labels_path": self.late_labels_path(),
             }
 
 

@@ -136,6 +136,33 @@ class StreamingUpdaterConfig:
     norm_drift_bound: float = 10.0
     num_iterations: int = 1
     re_convergence_tol: float = 1e-4
+    # Sharded freshness plane: this worker is shard ``shard_index`` of
+    # ``num_shards``. Records route by hashing the SAME per-entity string
+    # serving's ``_owned_mask`` hashes (stream/shard_router.py), so each
+    # worker's working set is a disjoint entity subset and its delta layers
+    # commute with every sibling's. (num_shards=1, shard_index=0) is the
+    # PR 11 single-updater plane byte-for-byte.
+    num_shards: int = 1
+    shard_index: int = 0
+    shard_vnodes: int = 64
+    shard_seed: int = 0
+    route_re_type: Optional[str] = None
+    # ``spool_dir`` already holds ONLY this shard's records (a materializing
+    # router — shard_router.route_segments — split the raw spool upstream),
+    # so skip read-side ring filtering and consume segments whole. Cursor
+    # manifests stay shard-tagged: routed sub-spools keep the source
+    # sequence numbers, so consumedThrough means the same thing.
+    pre_routed: bool = False
+    # Serialize the publish tail (save→manifest→gate→flip) under the
+    # publish root's flock and rebase onto the live LATEST. None = auto:
+    # on whenever sibling shards exist. Forcing True on a single updater
+    # is safe (and protects against a concurrent batch publisher).
+    serialize_publish: Optional[bool] = None
+    # FE-drift trigger scaffold: the streaming plane locks the fixed
+    # effect, so its age only grows. Past this bar the ``fe_age_s`` SLO
+    # objective starts burning and the ``stream_fe_retrain_wanted`` gauge
+    # raises — wiring for a future forced full retrain, no retrain yet.
+    fe_max_age_s: float = 3600.0
 
 
 @dataclasses.dataclass
@@ -246,21 +273,70 @@ class StreamingUpdater:
         self._cycles = 0
         self._publishes = 0
         self._stop = threading.Event()
+        # Busy-time accounting for the shard-scaling bench: wall seconds
+        # spent inside cycles (busy) and inside the train+publish step
+        # (train), plus records actually trained on. Σ_shards(records /
+        # busy) is the aggregate-throughput number `--updater-shard-ab`
+        # reports, mirroring the multichip busy-time methodology.
+        self._busy_s = 0.0
+        self._train_s = 0.0
+        self._records_trained = 0
+        if not (0 <= config.shard_index < max(1, config.num_shards)):
+            raise ValueError(
+                f"shard_index {config.shard_index} out of range for "
+                f"num_shards {config.num_shards}"
+            )
+        self._ring = None
+        if config.num_shards > 1:
+            from photon_tpu.stream.shard_router import shard_ring
+
+            self._ring = shard_ring(
+                config.num_shards,
+                vnodes=config.shard_vnodes,
+                seed=config.shard_seed,
+            )
         # Updater-side SLO plane: cycle success ratio + published-model
         # freshness — the training half of the serve-side tracker, so
-        # staleness is measurable when no server is running.
+        # staleness is measurable when no server is running — plus the
+        # locked-FE age objective feeding the retrain-wanted trigger.
         from photon_tpu.obs.slo import SLOTracker, streaming_objectives
 
-        self.slo = SLOTracker(objectives=streaming_objectives())
+        self.slo = SLOTracker(
+            objectives=streaming_objectives(
+                fe_age_threshold_s=config.fe_max_age_s
+            )
+        )
 
     # -- cursor ------------------------------------------------------------
 
+    def _cursor_matches(self, stream: Dict) -> bool:
+        """Whether a lineage ``stream`` block is THIS worker's cursor. A
+        sharded worker's cursor chain is the subsequence of manifests
+        tagged with its own ``shard`` identity — sibling shards' blocks are
+        walked through exactly like batch publishes. Untagged blocks (the
+        PR 11 single-updater plane) count for every shard: they record
+        segments the pre-shard plane fully consumed, so adopting them as a
+        floor is what makes a 1→N reshard resume without re-training old
+        traffic. A block from a DIFFERENT topology (other ``of``) is
+        skipped — resharding N→M needs a drained spool or a fresh full
+        publish (see README runbook)."""
+        if _CURSOR_KEY not in stream and _PER_SPOOL_KEY not in stream:
+            return False
+        shard = stream.get("shard")
+        if not shard:
+            return True
+        return (
+            int(shard.get("of", 0)) == self.config.num_shards
+            and int(shard.get("index", -1)) == self.config.shard_index
+        )
+
     def _cursor_stream_info(self) -> Dict:
         """The most recent ``stream`` manifest block in the published
-        lineage: walk parent links from ``LATEST`` and return the first
-        block carrying a cursor. A full (batch) publish interleaved into
-        the lineage carries no stream record and is walked through — its
-        parent chain still reaches the last streaming generation."""
+        lineage that belongs to THIS worker: walk parent links from
+        ``LATEST`` and return the first matching block. A full (batch)
+        publish — or a sibling shard's micro-generation — carries no
+        matching record and is walked through; its parent chain still
+        reaches this worker's last cursor."""
         from photon_tpu.cli.game_serving import resolve_model_dir
         from photon_tpu.io.model_io import load_generation_manifest
 
@@ -271,7 +347,7 @@ class StreamingUpdater:
         for _ in range(128):
             manifest = load_generation_manifest(cur) or {}
             stream = manifest.get("stream") or {}
-            if _CURSOR_KEY in stream or _PER_SPOOL_KEY in stream:
+            if self._cursor_matches(stream):
                 return stream
             parent = manifest.get("parent")
             if not parent:
@@ -346,6 +422,13 @@ class StreamingUpdater:
             )
 
     def _run_cycle(self) -> Optional[CycleResult]:
+        t_cycle = time.monotonic()
+        try:
+            return self._run_cycle_inner()
+        finally:
+            self._busy_s += time.monotonic() - t_cycle
+
+    def _run_cycle_inner(self) -> Optional[CycleResult]:
         from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
         from photon_tpu.obs.metrics import registry
         from photon_tpu.train.incremental import incremental_update
@@ -369,14 +452,41 @@ class StreamingUpdater:
         if not pending_pairs:
             return None
         records: List[dict] = []
-        for d, fn in pending_pairs:
-            faults.check("stream.consume", label=fn)
-            records.extend(read_segment(os.path.join(d, fn)))
+        records_routed = 0
+        if self._ring is not None and not cfg.pre_routed:
+            # Mixed segments split at record level: keep the rows this
+            # shard's ring slice owns, siblings pick up the rest from the
+            # same sealed files. Routing happens on the RAW lines
+            # (entityIds-only decode), so a shard pays a hash — not a full
+            # json parse — for every foreign record; that is what lets
+            # aggregate throughput scale with shard count when every
+            # worker lists the same sealed bytes. The cursor still
+            # advances over the WHOLE segment span consumed this cycle —
+            # ownership is a property of rows, not files.
+            from photon_tpu.stream.shard_router import read_owned_segment
+
+            for d, fn in pending_pairs:
+                faults.check("stream.consume", label=fn)
+                owned, total = read_owned_segment(
+                    os.path.join(d, fn), self._ring, cfg.shard_index,
+                    cfg.route_re_type,
+                )
+                records.extend(owned)
+                records_routed += total
+        else:
+            for d, fn in pending_pairs:
+                faults.check("stream.consume", label=fn)
+                records.extend(read_segment(os.path.join(d, fn)))
+            records_routed = len(records)
         if len(records) < cfg.min_records:
             return None
         self._cycles += 1
         reg = registry()
         reg.counter("stream_cycles_total").inc()
+        if cfg.num_shards > 1:
+            reg.counter(
+                "stream_shard_cycles_total", shard=str(cfg.shard_index)
+            ).inc()
 
         # Deterministic holdout split: every k-th record scores the gate's
         # regression bound instead of training. Determinism matters — a
@@ -390,6 +500,7 @@ class StreamingUpdater:
                 train_recs, holdout_recs = records, []
 
         faults.check("stream.consume", label="train")
+        t_train = time.monotonic()
         batch = records_to_batch(
             train_recs, self.index_maps, self.entity_indexes, intern=True
         )
@@ -425,6 +536,15 @@ class StreamingUpdater:
             "segments": pending,
             "records": len(records),
         }
+        if cfg.num_shards > 1:
+            # The shard identity tags this manifest as one link of THIS
+            # worker's cursor chain — siblings and restarts walk past
+            # non-matching blocks (see _cursor_matches).
+            stream_info["shard"] = {
+                "index": cfg.shard_index,
+                "of": cfg.num_shards,
+            }
+            stream_info["recordsRouted"] = records_routed
         if multi:
             # Only the multi-dir (fleet) layout needs per-spool cursors;
             # single-dir manifests keep the PR 11 shape byte-for-byte.
@@ -446,6 +566,9 @@ class StreamingUpdater:
             stream_info["traceCount"] = len(trace_ids)
             stream_info["traceIds"] = trace_ids[:32]
 
+        serialize = cfg.serialize_publish
+        if serialize is None:
+            serialize = cfg.num_shards > 1
         result = incremental_update(
             cfg.publish_root,
             batch,
@@ -463,12 +586,29 @@ class StreamingUpdater:
             re_convergence_tol=cfg.re_convergence_tol,
             emit_delta=emit_delta,
             extra_manifest={"stream": stream_info},
+            serialize_publish=bool(serialize),
         )
+        self._train_s += time.monotonic() - t_train
+        self._records_trained += len(records)
         reg.counter("stream_records_consumed_total").inc(len(records))
+        shard_labels = (
+            {"shard": str(cfg.shard_index)} if cfg.num_shards > 1 else None
+        )
+        if shard_labels:
+            reg.counter(
+                "stream_shard_records_total", **shard_labels
+            ).inc(len(records))
         staleness = None
         if result.published:
             self._publishes += 1
             reg.counter("stream_publishes_total").inc()
+            if shard_labels:
+                reg.counter(
+                    "stream_shard_publishes_total", **shard_labels
+                ).inc()
+                reg.gauge(
+                    "stream_shard_consumed_through", **shard_labels
+                ).set(consumed)
             if oldest_label_ts is not None:
                 staleness = time.time() - oldest_label_ts
                 reg.gauge("model_staleness_published_s").set(staleness)
@@ -478,6 +618,13 @@ class StreamingUpdater:
                 reg.gauge("model_staleness_s").set(staleness)
                 reg.histogram("model_staleness_hist_s").observe(staleness)
                 self.slo.record_staleness(staleness)
+                if shard_labels:
+                    # Per-shard freshness: one lagging shard is invisible
+                    # in the fleet-wide staleness gauge (siblings keep it
+                    # low) but pins its own label high.
+                    reg.gauge(
+                        "stream_shard_staleness_s", **shard_labels
+                    ).set(staleness)
             self.slo.record_event("update_cycle", True)
         else:
             # A refused generation means the freshness loop made no
@@ -490,6 +637,7 @@ class StreamingUpdater:
                 "through %d stay unconsumed and retry next cycle",
                 result.generation, result.gate_reason, consumed,
             )
+        self._observe_fe_age(reg)
         return CycleResult(
             generation=result.generation,
             published=result.published,
@@ -500,6 +648,65 @@ class StreamingUpdater:
             consumed_through=consumed,
             staleness_s=staleness,
         )
+
+    # -- FE-drift trigger scaffold ----------------------------------------
+
+    def fe_age_s(self) -> Optional[float]:
+        """Age of the locked fixed effect: seconds since the most recent
+        lineage generation that actually persisted FE coefficients (a full
+        publish, or a delta with ``include_fixed``). Delta layers from the
+        streaming plane lock the FE, so under pure streaming this only
+        grows — the drift signal the retrain trigger watches. None when
+        there is no published lineage yet."""
+        from photon_tpu.cli.game_serving import resolve_model_dir
+        from photon_tpu.io.model_io import (
+            FIXED_DIR,
+            load_generation_manifest,
+        )
+
+        root = self.config.publish_root
+        cur = resolve_model_dir(root)
+        if cur == root:
+            return None
+        for _ in range(128):
+            fe_dir = os.path.join(cur, FIXED_DIR)
+            if os.path.isdir(fe_dir) and os.listdir(fe_dir):
+                manifest = load_generation_manifest(cur) or {}
+                born = manifest.get("createdAt")
+                if born is None:
+                    try:
+                        born = os.path.getmtime(cur)
+                    except OSError:
+                        return None
+                return max(0.0, time.time() - float(born))
+            manifest = load_generation_manifest(cur) or {}
+            parent = manifest.get("parent")
+            if not parent:
+                return None
+            cur = os.path.join(root, parent)
+            if not os.path.isdir(cur):
+                return None
+        return None
+
+    def _observe_fe_age(self, reg) -> None:
+        """Feed the ``fe_age_s`` objective (same multi-window burn
+        machinery as staleness) and raise ``stream_fe_retrain_wanted``
+        while the locked FE is past its age bar. Wiring only: nothing
+        consumes the gauge yet — a future PR points a forced full retrain
+        at it."""
+        age = self.fe_age_s()
+        if age is None:
+            return
+        reg.gauge("stream_fe_age_s").set(age)
+        self.slo.record_fe_age(age)
+        wanted = 1.0 if age > float(self.config.fe_max_age_s) else 0.0
+        reg.gauge("stream_fe_retrain_wanted").set(wanted)
+        if wanted:
+            logger.warning(
+                "locked fixed effect is %.0fs old (bar %.0fs): "
+                "stream_fe_retrain_wanted raised", age,
+                self.config.fe_max_age_s,
+            )
 
     # -- driver loop -------------------------------------------------------
 
@@ -531,9 +738,18 @@ class StreamingUpdater:
         self._stop.set()
 
     def stats(self) -> dict:
-        return {
+        out = {
             "cycles": self._cycles,
             "publishes": self._publishes,
             "consumed_through": self.consumed_through(),
+            "busy_s": self._busy_s,
+            "train_s": self._train_s,
+            "records_trained": self._records_trained,
             "slo": self.slo.snapshot(),
         }
+        if self.config.num_shards > 1:
+            out["shard"] = {
+                "index": self.config.shard_index,
+                "of": self.config.num_shards,
+            }
+        return out

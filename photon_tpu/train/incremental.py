@@ -223,6 +223,7 @@ def incremental_update(
     publish: bool = True,
     emit_delta: bool = False,
     extra_manifest: Optional[dict] = None,
+    serialize_publish: bool = False,
 ) -> IncrementalResult:
     """One incremental generation, end to end: warm-start train on the
     delta ``batch`` → merge over the parent → save → manifest → gate →
@@ -239,13 +240,26 @@ def incremental_update(
     bit-identical to a full publish) — the streaming updater's micro-
     generation artifact. Falls back to a full publish when there is no
     parent or nothing qualifies for a layer. ``extra_manifest`` merges extra
-    keys into the generation manifest (e.g. the stream consume cursor)."""
+    keys into the generation manifest (e.g. the stream consume cursor).
+
+    ``serialize_publish=True`` runs the save→manifest→gate tail under the
+    publish root's :func:`~photon_tpu.io.model_io.publish_lock` and REBASES
+    onto whatever ``LATEST`` is at publish time: when a concurrent publisher
+    (a sibling updater shard) flipped the pointer since this cycle resolved
+    its warm-start parent, the changed rows are re-merged over the live
+    resolved model so the sibling's rows ride through instead of being
+    clobbered by this cycle's stale view. The changed rows themselves are
+    untouched by the rebase — per-entity solves depend only on the entity's
+    own warm start and data, so disjoint-entity publishers commute."""
+    import contextlib
+
     from photon_tpu.cli.game_serving import resolve_model_dir
     from photon_tpu.estimators.game_estimator import GameEstimator
     from photon_tpu.io.model_io import (
         allocate_generation,
         gate_and_publish,
         load_resolved_game_model,
+        publish_lock,
         save_delta_model,
         save_game_model,
         write_generation_manifest,
@@ -307,66 +321,100 @@ def incremental_update(
     if valid_batch is not None and evaluation_suite is not None:
         holdout = compute_holdout_metrics(merged, valid_batch, evaluation_suite)
 
-    # Allocation is flock-serialized: concurrent updaters (batch + streaming,
-    # or two streaming workers) must never claim the same generation id.
-    generation = generation or allocate_generation(publish_root)
-    model_dir = os.path.join(publish_root, generation)
-    is_delta = False
-    if emit_delta and parent is not None:
-        # Every RE coordinate needs a mask; a coordinate whose re_type the
-        # delta batch never mentioned changed nowhere (merge kept the parent
-        # rows verbatim), so it contributes no rows to the layer.
-        save_masks = dict(changed_masks)
-        for sub in merged.models.values():
-            if isinstance(sub, RandomEffectModel):
-                save_masks.setdefault(
-                    sub.re_type,
-                    np.zeros((np.asarray(sub.coefficients).shape[0],), bool),
-                )
-        fe_cids = [
-            cid for cid, sub in merged.models.items()
-            if isinstance(sub, FixedEffectModel)
-        ]
-        include_fixed = any(c not in locked_coordinates for c in fe_cids)
-        try:
-            save_delta_model(
-                merged, save_masks, model_dir, index_maps, entity_indexes,
-                base=parent_name, sparsity_threshold=sparsity_threshold,
-                include_fixed=include_fixed,
-            )
-            is_delta = True
-        except ValueError as exc:
-            logger.info("delta layer not emittable (%s); publishing full", exc)
-    if not is_delta:
-        save_game_model(
-            merged, model_dir, index_maps, entity_indexes,
-            sparsity_threshold=sparsity_threshold,
-        )
-    # Entity indexes grew with the delta's new entities; persist them BEFORE
-    # the pointer can move so a reloading server resolves every slot the new
-    # generation references. (Interning is append-only: existing slots are
-    # stable, so the running server's copy stays valid too.)
-    for shard, imap in index_maps.items():
-        imap.save(os.path.join(publish_root, f"index-map-{shard}.json"))
-    for re_type, eidx in entity_indexes.items():
-        eidx.save(os.path.join(publish_root, f"entity-index-{re_type}.json"))
-    extra = {"changedEntities": changed_counts}
-    if dead_letters:
-        extra["deadLetterChunks"] = dead_letters
-    if extra_manifest:
-        extra.update(extra_manifest)
-    write_generation_manifest(
-        model_dir, parent=parent_name, holdout_metrics=holdout, extra=extra
+    lock = (
+        publish_lock(publish_root) if serialize_publish
+        else contextlib.nullcontext()
     )
-    if publish:
-        gate = gate_and_publish(
-            publish_root, generation,
-            metric_tolerance=metric_tolerance,
-            norm_drift_bound=norm_drift_bound,
+    with lock:
+        publish_parent = parent_name
+        if serialize_publish:
+            live_dir = resolve_model_dir(publish_root)
+            live_ok = live_dir != publish_root and os.path.isdir(live_dir)
+            live_name = (
+                os.path.basename(live_dir.rstrip("/")) if live_ok else None
+            )
+            if live_ok and live_name != parent_name:
+                # Rebase: a sibling publisher flipped LATEST while this
+                # cycle trained. Re-merge the changed rows over the LIVE
+                # resolved model so the sibling's rows ride through
+                # verbatim; this cycle's trained rows are unaffected.
+                live_parent = load_resolved_game_model(
+                    live_dir, index_maps, entity_indexes, to_device=True,
+                    publish_root=publish_root,
+                )
+                merged = merge_models(live_parent, best.model, changed_masks)
+                publish_parent = live_name
+        # Allocation is flock-serialized: concurrent updaters (batch +
+        # streaming, or two streaming shard workers) must never claim the
+        # same generation id.
+        generation = generation or allocate_generation(publish_root)
+        model_dir = os.path.join(publish_root, generation)
+        is_delta = False
+        if emit_delta and publish_parent is not None:
+            # Every RE coordinate needs a mask; a coordinate whose re_type
+            # the delta batch never mentioned changed nowhere (merge kept
+            # the parent rows verbatim), so it contributes no rows to the
+            # layer.
+            save_masks = dict(changed_masks)
+            for sub in merged.models.values():
+                if isinstance(sub, RandomEffectModel):
+                    save_masks.setdefault(
+                        sub.re_type,
+                        np.zeros(
+                            (np.asarray(sub.coefficients).shape[0],), bool
+                        ),
+                    )
+            fe_cids = [
+                cid for cid, sub in merged.models.items()
+                if isinstance(sub, FixedEffectModel)
+            ]
+            include_fixed = any(c not in locked_coordinates for c in fe_cids)
+            try:
+                save_delta_model(
+                    merged, save_masks, model_dir, index_maps, entity_indexes,
+                    base=publish_parent,
+                    sparsity_threshold=sparsity_threshold,
+                    include_fixed=include_fixed,
+                )
+                is_delta = True
+            except ValueError as exc:
+                logger.info(
+                    "delta layer not emittable (%s); publishing full", exc
+                )
+        if not is_delta:
+            save_game_model(
+                merged, model_dir, index_maps, entity_indexes,
+                sparsity_threshold=sparsity_threshold,
+            )
+        # Entity indexes grew with the delta's new entities; persist them
+        # BEFORE the pointer can move so a reloading server resolves every
+        # slot the new generation references. (Interning is append-only:
+        # existing slots are stable, so the running server's copy stays
+        # valid too.)
+        for shard, imap in index_maps.items():
+            imap.save(os.path.join(publish_root, f"index-map-{shard}.json"))
+        for re_type, eidx in entity_indexes.items():
+            eidx.save(
+                os.path.join(publish_root, f"entity-index-{re_type}.json")
+            )
+        extra = {"changedEntities": changed_counts}
+        if dead_letters:
+            extra["deadLetterChunks"] = dead_letters
+        if extra_manifest:
+            extra.update(extra_manifest)
+        write_generation_manifest(
+            model_dir, parent=publish_parent, holdout_metrics=holdout,
+            extra=extra,
         )
-        published, reason = gate.ok, gate.reason
-    else:
-        published, reason = False, "publish_disabled"
+        if publish:
+            gate = gate_and_publish(
+                publish_root, generation,
+                metric_tolerance=metric_tolerance,
+                norm_drift_bound=norm_drift_bound,
+            )
+            published, reason = gate.ok, gate.reason
+        else:
+            published, reason = False, "publish_disabled"
     return IncrementalResult(
         generation=generation,
         model_dir=model_dir,
@@ -374,6 +422,6 @@ def incremental_update(
         gate_reason=reason,
         holdout_metrics=holdout,
         changed_entities=changed_counts,
-        parent=parent_name,
+        parent=publish_parent,
         is_delta=is_delta,
     )

@@ -411,9 +411,16 @@ def test_slo_gate_freezes_rolls_back_and_unfreezes(tmp_path):
 
 def test_streaming_objectives_cover_cycle_and_staleness():
     slo = SLOTracker(streaming_objectives())
-    assert set(slo.objectives) == {"update_cycle", "model_staleness_s"}
+    assert set(slo.objectives) == {
+        "update_cycle", "model_staleness_s", "fe_age_s",
+    }
     slo.record_event("update_cycle", True)
     slo.record_staleness(5.0)
+    slo.record_fe_age(10.0)
+    slo.record_fe_age(7200.0)
     snap = slo.snapshot()
     assert snap["objectives"]["update_cycle"]["events"] == 1
     assert snap["objectives"]["model_staleness_s"]["events"] == 1
+    # One good (under the 3600 s default bar) + one bad observation.
+    assert snap["objectives"]["fe_age_s"]["events"] == 2
+    assert snap["objectives"]["fe_age_s"]["threshold"] == 3600.0

@@ -667,9 +667,14 @@ def test_updater_publishes_delta_and_moves_cursor(tmp_path):
     assert upd.run_once() is None
     st = upd.stats()
     slo = st.pop("slo")
-    assert set(slo["objectives"]) == {"update_cycle", "model_staleness_s"}
+    assert set(slo["objectives"]) == {
+        "update_cycle", "model_staleness_s", "fe_age_s",
+    }
+    assert st.pop("busy_s") > 0.0
+    assert st.pop("train_s") > 0.0
     assert st == {
         "cycles": 1, "publishes": 1, "consumed_through": 2,
+        "records_trained": 16,
     }
 
 
@@ -843,3 +848,528 @@ def test_records_to_batch_matches_serving_densify():
     assert users[1] == 4 and eidx.lookup("brand-new") == 4  # appended
     np.testing.assert_array_equal(np.asarray(batch.label), [1.0, 0.0])
     np.testing.assert_array_equal(np.asarray(batch.offset), [0.25, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# Sharded updater plane (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_router_matches_serving_owned_mask():
+    """An updater shard's working set is literally a serving replica's
+    entity shard: shard_of_record hashes the identical string
+    serve/store._owned_mask hashes, so the partition agrees with
+    StorePartition.owns for every entity — and is disjoint + complete."""
+    from photon_tpu.serve.store import StorePartition
+    from photon_tpu.stream.shard_router import (
+        owned_records,
+        shard_members,
+        shard_of_record,
+        shard_ring,
+        split_records,
+    )
+
+    n_shards = 4
+    ring = shard_ring(n_shards)
+    eidx = make_entity_index(32)
+    records = [
+        {"entityIds": {"userId": eidx.entity_id(i)}} for i in range(32)
+    ]
+    for i, rec in enumerate(records):
+        k = shard_of_record(rec, ring)
+        for member in shard_members(n_shards):
+            part = StorePartition(member, ring, re_types=("userId",))
+            assert part.owns(eidx.entity_id(i)) == (
+                member == f"updater:{k}"
+            )
+    buckets = split_records(records, ring, n_shards)
+    assert sorted(k for v in buckets.values() for k in map(id, v)) == sorted(
+        map(id, records)
+    )
+    for k in range(n_shards):
+        assert buckets[k] == owned_records(records, ring, k)
+    # More than one shard actually owns something at this size.
+    assert sum(1 for v in buckets.values() if v) > 1
+    # Entity-less records (FE-only feedback) home deterministically on 0.
+    assert shard_of_record({"entityIds": {}}, ring) == 0
+    assert shard_of_record({}, ring) == 0
+
+
+def test_raw_line_routing_agrees_with_full_parse(tmp_path):
+    """The read-side fast path (entityIds-only decode of the raw line)
+    must route every record exactly where the full json parse would —
+    including adversarial uids that embed the token text, escaped quotes,
+    entity-less records, and corrupt tails."""
+    from photon_tpu.stream.shard_router import (
+        entity_ids_of_line,
+        read_owned_segment,
+        shard_of_record,
+        shard_ring,
+    )
+
+    records = [
+        {"uid": "plain", "entityIds": {"userId": "user3"}, "label": 1.0},
+        # Token text inside a string VALUE: json.dumps escapes the quotes,
+        # so the raw line never contains an unescaped '"entityIds":' from
+        # this uid — the extractor must still route on the real key.
+        {"uid": 'evil "entityIds": {"userId": "user0"}',
+         "entityIds": {"userId": "user5"}, "label": 0.0},
+        {"uid": 'esc\\"entityIds\\":', "entityIds": {"userId": "user1"}},
+        {"uid": "no-entities", "label": 1.0},
+        {"uid": "null-ids", "entityIds": None},
+        {"uid": "multi", "entityIds": {"b": "user2", "a": "user6"}},
+    ]
+    for rec in records:
+        line = json.dumps(rec)
+        ok, ids = entity_ids_of_line(line)
+        assert ok, line
+        assert ids == rec.get("entityIds"), (line, ids)
+
+    ring = shard_ring(4)
+    path = str(tmp_path / "segment-00000001.jsonl")
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+        # Torn mid-entityIds: extraction fails -> full-parse fallback
+        # fails -> every shard skips and counts it identically.
+        f.write('{"uid": "torn", "entityIds": {"user\n')
+        # Torn AFTER a complete entityIds: only the owner full-parses (and
+        # skips) it; non-owners route past on the prefix, so their totals
+        # legitimately run one high — corruption is only detectable where
+        # the record lands.
+        f.write('{"uid": "torn2", "entityIds": {"userId": "user7"}, "la\n')
+    owner7 = shard_of_record({"entityIds": {"userId": "user7"}}, ring)
+    expect = {k: [] for k in range(4)}
+    for rec in records:
+        expect[shard_of_record(rec, ring)].append(rec["uid"])
+    for k in range(4):
+        owned, total = read_owned_segment(path, ring, k)
+        assert total == len(records) + (0 if k == owner7 else 1)
+        assert [r["uid"] for r in owned] == expect[k]
+
+
+def _drain_shards(updaters, max_rounds=8):
+    """Round-robin run_once over shard workers until a full round consumes
+    nothing — a deterministic interleaved-publish schedule."""
+    results = []
+    for _ in range(max_rounds):
+        progressed = False
+        for upd in updaters:
+            res = upd.run_once()
+            if res is not None:
+                assert res.published, res.gate_reason
+                results.append(res)
+                progressed = True
+        if not progressed:
+            return results
+    raise AssertionError("shard workers did not drain the spool")
+
+
+def _resolved_re(root, imaps, eidx):
+    from photon_tpu.cli.game_serving import resolve_model_dir
+    from photon_tpu.io.model_io import load_resolved_game_model
+
+    model = load_resolved_game_model(
+        resolve_model_dir(root), imaps, {"userId": eidx}, to_device=False,
+    )
+    return np.asarray(model.models["per_user"].coefficients)
+
+
+def _sharded_segments(sdir):
+    """Four mixed segments spanning every test entity — mixed on purpose,
+    so routing must split records, not files."""
+    s = []
+    s.append(_write_segment(sdir, 1, _segment_records(8, [0, 3, 5], seed=91)))
+    s.append(_write_segment(sdir, 2, _segment_records(8, [1, 2, 6], seed=92)))
+    s.append(_write_segment(sdir, 3, _segment_records(8, [4, 7, 0], seed=93)))
+    s.append(_write_segment(sdir, 4, _segment_records(8, [2, 5, 1], seed=94)))
+    return s
+
+
+def test_sharded_updaters_compose_bit_identical_to_single(tmp_path):
+    """The tentpole invariant: N shard workers consuming the same mixed
+    segments through interleaved delta publishes compose to the SAME bits
+    as one updater consuming everything — disjoint rows commute."""
+    from photon_tpu.io.model_io import layers_commute, resolve_delta_chain
+
+    # Reference: single updater, two cycles of two segments each.
+    root_a = str(tmp_path / "single")
+    os.makedirs(root_a)
+    _, imaps_a, eidx_a = _updater_root(root_a)
+    _sharded_segments(os.path.join(root_a, "spool"))
+    single = _updater(root_a, os.path.join(root_a, "spool"), imaps_a, eidx_a,
+                      min_records=1, norm_drift_bound=1e12, max_segments_per_cycle=2)
+    assert len(_drain_shards([single])) == 2
+    ref = _resolved_re(root_a, imaps_a, eidx_a)
+
+    # Sharded: 3 workers over the same segment bytes, interleaved publishes.
+    root_b = str(tmp_path / "sharded")
+    os.makedirs(root_b)
+    _, imaps_b, eidx_b = _updater_root(root_b)
+    sdir_b = os.path.join(root_b, "spool")
+    _sharded_segments(sdir_b)
+    shards = [
+        _updater(root_b, sdir_b, imaps_b, eidx_b, min_records=1, norm_drift_bound=1e12,
+                 max_segments_per_cycle=2, num_shards=3, shard_index=k)
+        for k in range(3)
+    ]
+    results = _drain_shards(shards)
+    assert all(r.is_delta for r in results)
+    got = _resolved_re(root_b, imaps_b, eidx_b)
+    np.testing.assert_array_equal(ref, got)
+
+    # Every pair of shard layers in the lineage is row-disjoint.
+    chain = resolve_delta_chain(
+        os.path.join(root_b, results[-1].generation), root_b
+    )
+    layers = [d for d in chain[1:]]
+    by_gen = {os.path.basename(d): d for d in layers}
+    from photon_tpu.io.model_io import load_generation_manifest
+
+    shard_of_gen = {}
+    for gen, d in by_gen.items():
+        man = load_generation_manifest(d) or {}
+        shard_of_gen[gen] = (man.get("stream") or {}).get("shard", {}).get(
+            "index"
+        )
+    for i, a in enumerate(layers):
+        for b in layers[i + 1:]:
+            ga, gb = os.path.basename(a), os.path.basename(b)
+            if shard_of_gen[ga] != shard_of_gen[gb]:
+                assert layers_commute(a, b), (ga, gb)
+
+    # Per-shard cursor chains are independent: each worker reads its own.
+    for upd in shards:
+        if upd.stats()["publishes"]:
+            assert upd.consumed_through() == 4
+
+
+def test_concurrent_shard_publishes_rebase_to_linear_chain(tmp_path):
+    """Two shard workers racing through the flock'd publish tail: whatever
+    the thread interleaving, the lineage stays a single parent chain and
+    the composed model matches the single-updater reference bitwise (the
+    loser of the LATEST race rebases its commuting layer)."""
+    root_a = str(tmp_path / "single")
+    os.makedirs(root_a)
+    _, imaps_a, eidx_a = _updater_root(root_a)
+    _sharded_segments(os.path.join(root_a, "spool"))
+    single = _updater(root_a, os.path.join(root_a, "spool"), imaps_a, eidx_a,
+                      min_records=1, norm_drift_bound=1e12)
+    _drain_shards([single])
+    ref = _resolved_re(root_a, imaps_a, eidx_a)
+
+    root_b = str(tmp_path / "sharded")
+    os.makedirs(root_b)
+    _, imaps_b, eidx_b = _updater_root(root_b)
+    sdir_b = os.path.join(root_b, "spool")
+    _sharded_segments(sdir_b)
+    shards = [
+        _updater(root_b, sdir_b, imaps_b, eidx_b, min_records=1, norm_drift_bound=1e12,
+                 num_shards=2, shard_index=k)
+        for k in range(2)
+    ]
+    errs = []
+
+    def drive(upd):
+        try:
+            for _ in range(4):
+                if upd.run_once() is None:
+                    break
+        except Exception as exc:  # noqa: BLE001 — surface in main thread
+            errs.append(exc)
+
+    threads = [threading.Thread(target=drive, args=(u,)) for u in shards]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs
+    assert all(u.stats()["publishes"] >= 1 for u in shards)
+    got = _resolved_re(root_b, imaps_b, eidx_b)
+    np.testing.assert_array_equal(ref, got)
+    # Linear lineage: walking parents from LATEST reaches gen-1 and visits
+    # every published generation exactly once.
+    from photon_tpu.cli.game_serving import resolve_model_dir
+    from photon_tpu.io.model_io import load_generation_manifest
+
+    seen = []
+    cur = resolve_model_dir(root_b)
+    while True:
+        name = os.path.basename(cur)
+        assert name not in seen
+        seen.append(name)
+        parent = (load_generation_manifest(cur) or {}).get("parent")
+        if not parent:
+            break
+        cur = os.path.join(root_b, parent)
+    publishes = sum(u.stats()["publishes"] for u in shards)
+    assert seen[-1] == "gen-1" and len(seen) == publishes + 1
+
+
+def test_sharded_crash_independence(tmp_path):
+    """SIGKILL-equivalent mid-cycle death of ONE shard worker: siblings
+    keep publishing on their own cursor chains; the restarted shard resumes
+    from ITS cursor and the final composed model is bit-identical to an
+    uninterrupted 3-shard run."""
+    from photon_tpu.utils.faults import PermanentInjectedFault
+
+    def run(root, crash):
+        os.makedirs(root, exist_ok=True)
+        _, imaps, eidx = _updater_root(root)
+        sdir = os.path.join(root, "spool")
+        _sharded_segments(sdir)
+
+        def worker(k):
+            return _updater(root, sdir, imaps, eidx, min_records=1, norm_drift_bound=1e12,
+                            num_shards=3, shard_index=k)
+
+        shards = [worker(k) for k in range(3)]
+        if crash:
+            # The victim dies right before its solve — segments read,
+            # nothing published, cursor untouched.
+            faults.configure(FaultPlan(rules=(
+                FaultRule("stream.consume", kind="permanent", at=(4,)),
+            )))
+            with pytest.raises(PermanentInjectedFault):
+                shards[1].run_once()
+            faults.reset()
+            assert shards[1].consumed_through() == 0
+            # Siblings are unaffected: they publish their subsets.
+            r0, r2 = shards[0].run_once(), shards[2].run_once()
+            assert r0.published and r2.published
+            assert shards[0].consumed_through() == 4
+            assert shards[2].consumed_through() == 4
+            assert shards[1].consumed_through() == 0  # victim's own cursor
+            # Restart: a fresh worker for the same shard id resumes from
+            # the victim's (unmoved) cursor and re-lands deterministically.
+            shards[1] = worker(1)
+            r1 = shards[1].run_once()
+            assert r1.published and shards[1].consumed_through() == 4
+        else:
+            for upd in shards:
+                res = upd.run_once()
+                assert res is not None and res.published
+        assert _drain_shards(shards) == []  # everything consumed
+        return _resolved_re(root, imaps, eidx)
+
+    clean = run(str(tmp_path / "clean"), crash=False)
+    crashed = run(str(tmp_path / "crashed"), crash=True)
+    np.testing.assert_array_equal(clean, crashed)
+
+
+def test_spool_late_label_sidecar(tmp_path):
+    """TTL-evicted joins are reclaimable, not lost: eviction writes the
+    scored half to late-labels.jsonl, the late-arriving label writes the
+    other half, and the counters measure both."""
+    import time as time_mod
+
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.stream.spool import LATE_LABELS_FILE
+
+    spooled0 = registry().counter("feedback_late_spooled_total").value
+    spool = FeedbackSpool(str(tmp_path), SpoolConfig(join_ttl_s=0.01))
+    assert spool.observe_scored(
+        "slow-uid", features={"global": [1.0] * D_FIX},
+        entity_ids={"userId": "user0"}, ts=100.0,
+    )
+    time_mod.sleep(0.05)
+    # The next scored request runs the eviction sweep past the TTL.
+    assert spool.observe_scored("fresh-uid", entity_ids={"userId": "user1"})
+    # The label arrives after eviction: late, side-spooled, not joined.
+    assert not spool.observe_label("slow-uid", 1.0, ts=400.0)
+    path = os.path.join(str(tmp_path), LATE_LABELS_FILE)
+    assert spool.late_labels_path() == path
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert [ln["kind"] for ln in lines] == ["evicted", "late_label"]
+    assert lines[0]["record"]["uid"] == "slow-uid"
+    assert lines[0]["record"]["entityIds"] == {"userId": "user0"}
+    assert lines[1] == {
+        "kind": "late_label", "uid": "slow-uid", "label": 1.0,
+        "labelTs": 400.0,
+    }
+    assert (
+        registry().counter("feedback_late_spooled_total").value - spooled0
+        == 2
+    )
+    # The sidecar never masquerades as a consumable segment.
+    assert LATE_LABELS_FILE not in sealed_segments(str(tmp_path))
+    spool.close()
+
+
+def test_updater_fe_age_objective_and_retrain_gauge(tmp_path):
+    """FE-drift trigger scaffold: the locked FE's age feeds the fe_age_s
+    objective every cycle, and stream_fe_retrain_wanted raises once the
+    age passes the configured bar (wiring only — nothing retrains)."""
+    from photon_tpu.obs.metrics import registry
+
+    root, sdir = str(tmp_path / "pub"), str(tmp_path / "spool")
+    os.makedirs(root)
+    _, imaps, eidx = _updater_root(root)
+    _write_segment(sdir, 1, _segment_records(8, [0, 1], seed=95))
+    upd = _updater(root, sdir, imaps, eidx)
+    res = upd.run_once()
+    assert res.published
+    age = upd.fe_age_s()
+    # gen-1 (the only FE-bearing layer: streaming deltas lock the FE) was
+    # published moments ago.
+    assert age is not None and 0.0 <= age < 60.0
+    assert registry().gauge("stream_fe_retrain_wanted").value == 0.0
+    snap = upd.stats()["slo"]
+    assert snap["objectives"]["fe_age_s"]["events"] == 1
+
+    # Same lineage, a worker configured with an already-expired bar.
+    _write_segment(sdir, 2, _segment_records(8, [2], seed=96))
+    stale = _updater(root, sdir, imaps, eidx, fe_max_age_s=1e-9)
+    res = stale.run_once()
+    assert res.published
+    assert registry().gauge("stream_fe_retrain_wanted").value == 1.0
+    assert registry().gauge("stream_fe_age_s").value > 0.0
+    snap = stale.stats()["slo"]
+    assert snap["objectives"]["fe_age_s"]["events"] == 1
+    assert snap["objectives"]["fe_age_s"]["threshold"] == 1e-9
+
+
+def test_route_segments_materializes_disjoint_subspools(tmp_path):
+    """The materializing router: every sealed segment splits into N
+    per-shard sub-spool segments that (a) partition the source records
+    exactly as read-side routing would, line-for-line and in order,
+    (b) keep the source sequence numbers, (c) exist for EVERY shard (an
+    empty file is the routed-ness marker), and (d) survive idempotent and
+    crash-interrupted re-runs byte-identically."""
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.stream.shard_router import (
+        route_segments,
+        shard_of_record,
+        shard_ring,
+        shard_spool_dir,
+    )
+    from photon_tpu.stream.spool import read_segment
+
+    sdir = str(tmp_path / "spool")
+    names = _sharded_segments(sdir)
+    # A fifth segment exercising the edge lines: an entity-less record
+    # (homes on shard 0), a corrupt tokenless line (passes through to
+    # shard 0 verbatim — shard 0's read_segment skips and counts it, the
+    # same place read-side routing charges it), a line torn INSIDE
+    # entityIds (ambiguous prefix: the router full-parses, fails, drops
+    # it for every shard and counts it itself), and a plain routed record.
+    extra = "segment-00000005.jsonl"
+    with open(os.path.join(sdir, extra), "w") as f:
+        f.write(json.dumps({"uid": "fe-only", "label": 1.0}) + "\n")
+        f.write("{not json\n")
+        f.write('{"uid": "torn", "entityIds": {"user\n')
+        f.write(json.dumps(
+            {"uid": "ok", "entityIds": {"userId": "user3"}}) + "\n")
+    names.append(extra)
+
+    out = str(tmp_path / "routed")
+    n_shards = 3
+    ring = shard_ring(n_shards)
+    bad0 = registry().counter("feedback_spool_bad_lines_total").value
+    assert route_segments(sdir, out, n_shards) == len(names)
+    assert (
+        registry().counter("feedback_spool_bad_lines_total").value - bad0
+        == 1  # the torn-entityIds line; the tokenless one rides through
+    )
+
+    def shard_bytes():
+        return {
+            (k, fn): open(
+                os.path.join(shard_spool_dir(out, k), fn), "rb").read()
+            for k in range(n_shards) for fn in names
+        }
+
+    first = shard_bytes()  # raises if any shard file is missing
+    for fn in names[:4]:  # the all-valid mixed segments
+        src_lines = [
+            ln for ln in open(os.path.join(sdir, fn)).read().splitlines()
+            if ln.strip()
+        ]
+        merged = []
+        for k in range(n_shards):
+            lines = first[(k, fn)].decode().splitlines()
+            # Every routed line is a verbatim source line owned by k.
+            for ln in lines:
+                assert ln in src_lines
+                assert shard_of_record(json.loads(ln), ring) == k
+            merged.extend(lines)
+        assert sorted(merged) == sorted(src_lines)  # disjoint + complete
+        # Per-shard order preserved == read-side filtered order.
+        for k in range(n_shards):
+            assert first[(k, fn)].decode().splitlines() == [
+                ln for ln in src_lines
+                if shard_of_record(json.loads(ln), ring) == k
+            ]
+    # Edge segment: exact expected placement.
+    owner3 = shard_of_record({"entityIds": {"userId": "user3"}}, ring)
+    per_shard = {
+        k: first[(k, extra)].decode().splitlines() for k in range(n_shards)
+    }
+    assert per_shard[0][:2] == [
+        json.dumps({"uid": "fe-only", "label": 1.0}), "{not json"
+    ]
+    assert sum(len(v) for v in per_shard.values()) == 3  # torn is dropped
+    assert per_shard[owner3][-1] == json.dumps(
+        {"uid": "ok", "entityIds": {"userId": "user3"}})
+    assert not any("torn" in ln for v in per_shard.values() for ln in v)
+    # Routed sub-spools are real spools: read_segment parses them.
+    assert len(read_segment(
+        os.path.join(shard_spool_dir(out, 0), names[0]))) == len(
+        first[(0, names[0])].decode().splitlines())
+
+    # Idempotent: a second pass routes nothing and changes no byte.
+    assert route_segments(sdir, out, n_shards) == 0
+    assert shard_bytes() == first
+    # Crash re-run: losing ONE shard file of a segment re-routes exactly
+    # that segment, byte-identically, touching nothing else.
+    os.unlink(os.path.join(shard_spool_dir(out, 1), names[2]))
+    assert route_segments(sdir, out, n_shards) == 1
+    assert shard_bytes() == first
+
+
+def test_pre_routed_workers_match_read_side_filtering(tmp_path):
+    """Consuming materialized sub-spools (pre_routed=True) composes to the
+    same bits as read-side ring filtering over the raw spool — the router
+    changes WHERE the partition is paid for, never what it is. Cursor
+    chains keep working because routed segments keep source seqs."""
+    from photon_tpu.stream.shard_router import (
+        route_segments,
+        shard_spool_dir,
+    )
+
+    n_shards = 3
+    # Reference: read-side filtering, every worker lists the raw spool.
+    root_a = str(tmp_path / "readside")
+    os.makedirs(root_a)
+    _, imaps_a, eidx_a = _updater_root(root_a)
+    sdir_a = os.path.join(root_a, "spool")
+    _sharded_segments(sdir_a)
+    shards_a = [
+        _updater(root_a, sdir_a, imaps_a, eidx_a, min_records=1,
+                 norm_drift_bound=1e12, num_shards=n_shards, shard_index=k)
+        for k in range(n_shards)
+    ]
+    _drain_shards(shards_a)
+    ref = _resolved_re(root_a, imaps_a, eidx_a)
+
+    # Same bytes through the materializing router + pre-routed workers.
+    root_b = str(tmp_path / "routed")
+    os.makedirs(root_b)
+    _, imaps_b, eidx_b = _updater_root(root_b)
+    sdir_b = os.path.join(root_b, "spool")
+    _sharded_segments(sdir_b)
+    out = os.path.join(sdir_b, ".shards")
+    assert route_segments(sdir_b, out, n_shards) == 4
+    shards_b = [
+        _updater(root_b, shard_spool_dir(out, k), imaps_b, eidx_b,
+                 min_records=1, norm_drift_bound=1e12,
+                 num_shards=n_shards, shard_index=k, pre_routed=True)
+        for k in range(n_shards)
+    ]
+    _drain_shards(shards_b)
+    np.testing.assert_array_equal(ref, _resolved_re(root_b, imaps_b, eidx_b))
+    for a, b in zip(shards_a, shards_b):
+        assert a.consumed_through() == b.consumed_through() == 4
+        assert (a.stats()["records_trained"]
+                == b.stats()["records_trained"])
