@@ -3415,6 +3415,419 @@ raise SystemExit("expected SIGKILL before run_once returned")
     }
 
 
+def run_freshness_lift(smoke: bool = False, E: int = 64, hot_entities: int = 8):
+    """Freshness-lift headline (--freshness-lift): the number that
+    justifies the streaming subsystem, MEASURED — plus the quality-burn
+    actuation drill.
+
+    Phase A (lift): gen-1 serves live traffic whose per-entity behavior
+    DRIFTS over time (true per-user weights walk away from gen-1's), the
+    streaming updater keeps publishing fresh deltas that track the drift,
+    and the engine's quality plane measures two online AUC curves over the
+    SAME labeled requests: the fresh primary lane and a frozen gen-1
+    baseline lane (``enable_quality_baseline`` re-scores every joined
+    label on pinned gen-1). The headline is their difference — the online
+    AUC lift fresh deltas buy over the frozen baseline — and it must come
+    out positive, with ZERO caller errors and ZERO post-warmup retraces.
+
+    Phase B (quality-burn drill): with the watcher's ``--slo-gate`` armed
+    on drill-scale burn windows and the quality objectives in the default
+    gate list, one more generation publishes and promotes; then the label
+    stream SHIFTS (labels invert — the canonical silent-regression shape).
+    The promoted version's windowed AUC craters below the baseline's,
+    ``auc_drop`` burns to paging, and the UNCHANGED PR 15 actuation path
+    rolls the in-settle promotion back, poisons it, repoints LATEST, and
+    freezes promotions — "the new model is worse" as a paged, auto-
+    reverted event, measured end to end.
+    """
+    import os
+    import tempfile
+    import threading
+
+    from photon_tpu.cli.game_serving import RolloutOptions, _reload_watcher
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        is_poisoned,
+        load_game_model,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.obs.quality import (
+        QualityAccumulator,
+        QualityConfig,
+        QualityPlane,
+    )
+    from photon_tpu.obs.slo import (
+        DRILL_PAGE_RULES,
+        DRILL_WARN_RULES,
+        SLOTracker,
+        default_objectives,
+        quality_objectives,
+    )
+    from photon_tpu.serve import ScoreRequest, ServeConfig, ServingEngine
+    from photon_tpu.stream.spool import FeedbackSpool, SpoolConfig
+    from photon_tpu.stream.updater import (
+        StreamingUpdater,
+        StreamingUpdaterConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    d_fix, d_re = 5, 3
+    task = TaskType.LOGISTIC_REGRESSION
+    coord_configs = [
+        FixedEffectCoordinateConfig("global", "global"),
+        RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+    ]
+    if smoke:
+        window_s, num_windows = 4.0, 4
+        promotions_target, pool_min = 2, 150
+        lift_bar, drift_rate = 0.02, 0.5
+        phase_a_timeout = 180.0
+    else:
+        window_s, num_windows = 8.0, 5
+        promotions_target, pool_min = 3, 400
+        lift_bar, drift_rate = 0.05, 0.25
+        phase_a_timeout = 360.0
+
+    # gen-1's weights ARE the true weights at t=0 — the baseline starts
+    # perfect and only decays because the world moves, which is exactly
+    # the claim the lift number quantifies.
+    rng = np.random.default_rng(71)
+    w_fix = rng.normal(size=d_fix).astype(np.float32)
+    w_re = rng.normal(size=(E, d_re)).astype(np.float32)
+    drift_dir = np.random.default_rng(77).normal(
+        size=(hot_entities, d_re)
+    ).astype(np.float32)
+
+    root = tempfile.mkdtemp(prefix="freshness-lift-")
+    sdir = os.path.join(root, "spool")
+    imaps = {
+        "global": IndexMap.build([f"g{j}" for j in range(d_fix)]),
+        "per_user": IndexMap.build([f"r{j}" for j in range(d_re)]),
+    }
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"user{e}")
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    g1 = os.path.join(root, "gen-1")
+    save_game_model(
+        GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(Coefficients(w_fix), task), "global"
+            ),
+            "per_user": RandomEffectModel(w_re, "userId", "per_user", task),
+        }),
+        g1, imaps, {"userId": eidx}, sparsity_threshold=0.0,
+    )
+    write_generation_manifest(g1, parent=None)
+    assert gate_and_publish(root, "gen-1").ok
+
+    _progress("freshness lift: starting serve + updater under drift")
+    engine = ServingEngine(
+        load_game_model(g1, imaps, {"userId": eidx}, to_device=False),
+        entity_indexes={"userId": eidx}, index_maps=imaps,
+        config=ServeConfig(max_batch_size=8, max_delay_ms=1.0,
+                           hot_bytes=1 << 30, max_versions=4,
+                           shadow_fraction=1.0, promotion_settle_s=300.0),
+        model_version=g1,
+    )
+    # Bench-scale quality windows; deterministic threshold labels make
+    # ECE legitimately large, so the calibration bar is set loose — the
+    # drill asserts auc_drop specifically. Phase A keeps PRODUCTION burn
+    # windows (early 24-record micro-generations can transiently rank
+    # worse than the still-near-perfect baseline; that is noise, not a
+    # page); the drill-scale tracker swaps in for phase B only.
+    engine.quality = QualityPlane(QualityConfig(
+        task="logistic", window_s=window_s, num_windows=num_windows,
+        min_events=20, auc_drop_bound=0.05, ece_bound=0.9,
+    ))
+    engine.slo = SLOTracker(
+        default_objectives() + quality_objectives(), bucket_s=1.0,
+    )
+    spool = FeedbackSpool(sdir, SpoolConfig(segment_max_records=24,
+                                            segment_max_age_s=0.25))
+    spool.start_auto_flush()
+    engine.attach_feedback(spool)
+    engine.enable_quality_baseline("gen-1", fraction=1.0)
+
+    base_scored0 = registry().counter("quality_baseline_scored_total").value
+    base_errors0 = registry().counter("quality_baseline_errors_total").value
+
+    stop_a = threading.Event()
+    watcher_a = threading.Thread(
+        target=_reload_watcher,
+        args=(engine, root, 0.05, stop_a,
+              RolloutOptions(shadow_fraction=1.0, shadow_quota=8,
+                             divergence_bound=1e6, breaker_trip_bound=1000,
+                             max_reload_attempts=3, backoff_s=0.05)),
+        daemon=True,
+    )
+    watcher_a.start()
+    updater = StreamingUpdater(
+        StreamingUpdaterConfig(
+            publish_root=root, spool_dir=sdir, task=task,
+            coordinate_configs=coord_configs,
+            update_sequence=["global", "per_user"],
+            cadence_s=0.2, min_records=24, locked_coordinates=["global"],
+            delta_artifacts=True, num_iterations=1, norm_drift_bound=1e4,
+        ),
+        imaps, {"userId": eidx},
+    )
+    upd_thread = threading.Thread(target=updater.run_forever, daemon=True)
+    upd_thread.start()
+
+    Xf = np.random.default_rng(72).normal(size=(64, d_fix)).astype(np.float32)
+    Xr = np.random.default_rng(73).normal(size=(64, d_re)).astype(np.float32)
+    Xf[:, 0] = 1.0
+    Xr[:, 0] = 1.0
+    ok = errors = 0
+    lock = threading.Lock()
+    done = threading.Event()
+    shift = threading.Event()  # phase B: the injected label shift
+    t_drift0 = time.monotonic()
+
+    def true_label(i, u):
+        elapsed = time.monotonic() - t_drift0
+        w_true = w_re[u] + drift_rate * elapsed * drift_dir[u]
+        logit = float(Xf[i] @ w_fix + Xr[i] @ w_true)
+        y = 1.0 if logit > 0 else 0.0
+        return 1.0 - y if shift.is_set() else y
+
+    def producer(seed):
+        nonlocal ok, errors
+        r = np.random.default_rng(seed)
+        k = 0
+        while not done.is_set():
+            i = int(r.integers(0, 64))
+            u = int(r.integers(0, hot_entities))
+            uid = f"{seed}-{k}:{i}:{u}"
+            k += 1
+            try:
+                engine.submit(ScoreRequest(
+                    {"global": Xf[i], "per_user": Xr[i]},
+                    {"userId": f"user{u}"},
+                    uid=uid,
+                )).result(timeout=120)
+                engine.feedback_label(uid, true_label(i, u))
+                with lock:
+                    ok += 1
+            except Exception:  # noqa: BLE001 — any escape fails the bench
+                with lock:
+                    errors += 1
+            time.sleep(0.002)
+
+    producers = [threading.Thread(target=producer, args=(s,), daemon=True)
+                 for s in (201, 202)]
+    t_start = time.perf_counter()
+    for t in producers:
+        t.start()
+
+    def wait_for(pred, timeout, msg):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"freshness lift: timed out waiting for {msg}")
+
+    def basename(v):
+        return os.path.basename(str(v).rstrip("/"))
+
+    def pooled():
+        """(fresh, baseline) lane accumulators over the retained windows:
+        every non-baseline version key merges into the fresh lane — the
+        merge is exact, so pooling loses nothing."""
+        cfg = engine.quality.config
+        fresh = QualityAccumulator(cfg.score_bins, cfg.calibration_bins)
+        base = QualityAccumulator(cfg.score_bins, cfg.calibration_bins)
+        for key, acc in engine.quality.window_totals().items():
+            (base if key[0] == "gen-1" else fresh).merge(acc)
+        return fresh, base
+
+    def measured_lift():
+        fresh, base = pooled()
+        if fresh.count < pool_min or base.count < pool_min:
+            return None
+        fa, ba = fresh.auc(), base.auc()
+        if fa is None or ba is None:
+            return None
+        return fa, ba, fa - ba
+
+    # Phase A: fresh deltas must keep promoting under drift, and the
+    # measured fresh-vs-frozen AUC gap must open past the lift bar.
+    _progress("freshness lift: waiting for promotions + measured lift")
+    promoted = []
+
+    def note_promotions():
+        v = basename(engine.model_version)
+        if v != "gen-1" and (not promoted or promoted[-1] != v):
+            promoted.append(v)
+        return len(promoted) >= promotions_target
+
+    wait_for(note_promotions, phase_a_timeout,
+             f"{promotions_target} fresh-delta promotions")
+    lift_samples = []
+
+    def lift_ok():
+        m = measured_lift()
+        if m is not None and m[2] >= lift_bar:
+            lift_samples.append(m)
+            return True
+        return False
+
+    wait_for(lift_ok, phase_a_timeout,
+             f"measured online AUC lift ≥ {lift_bar}")
+    fresh_auc, baseline_auc, lift = lift_samples[-1]
+    engine.quality.publish()
+    baseline_scored = (
+        registry().counter("quality_baseline_scored_total").value
+        - base_scored0
+    )
+    baseline_errors = (
+        registry().counter("quality_baseline_errors_total").value
+        - base_errors0
+    )
+    fresh_pool, base_pool = pooled()
+    delay_p95 = fresh_pool.delay_percentile(0.95)
+    assert baseline_scored > 0, "baseline lane never scored a request"
+    assert baseline_errors == 0, (
+        f"{baseline_errors} baseline re-score errors"
+    )
+
+    # Phase B: arm the gate (quality objectives ride the DEFAULT list),
+    # promote one more generation, then shift the labels out from under it.
+    _progress("freshness lift: quality-burn drill (label shift → rollback)")
+    updater.stop()
+    upd_thread.join(timeout=120)
+    assert not upd_thread.is_alive(), "updater thread failed to stop"
+    stop_a.set()
+    watcher_a.join(timeout=10)
+
+    def gate_actions(action):
+        return registry().counter(
+            "serve_slo_gate_actions_total", action=action
+        ).value
+
+    base_act = {a: gate_actions(a) for a in (
+        "freeze", "unfreeze", "shadow_abort", "slo_rollback",
+    )}
+    prev_primary = basename(engine.model_version)
+    # Drill-scale burn windows for phase B, quality objectives riding in
+    # the SAME tracker availability/latency use — one gate, four reasons
+    # to pull it. Fresh rings: phase A's transients don't pre-burn them.
+    engine.slo = SLOTracker(
+        default_objectives() + quality_objectives(),
+        page_rules=DRILL_PAGE_RULES, warn_rules=DRILL_WARN_RULES,
+        bucket_s=1.0,
+    )
+    stop_b = threading.Event()
+    watcher_b = threading.Thread(
+        target=_reload_watcher,
+        args=(engine, root, 0.05, stop_b,
+              RolloutOptions(shadow_fraction=1.0, shadow_quota=8,
+                             divergence_bound=1e6, slo_gate=True,
+                             max_reload_attempts=3, backoff_s=0.05)),
+        daemon=True,
+    )
+    watcher_b.start()
+    drill_res = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        spool.flush()
+        res = updater.run_once()
+        if res is not None and res.published:
+            drill_res = res
+            break
+        time.sleep(0.2)
+    assert drill_res is not None, "no drill generation published"
+    drill_gen = drill_res.generation
+    wait_for(lambda: basename(engine.model_version) == drill_gen, 90,
+             f"promotion of {drill_gen}")
+    assert engine.promotion_in_window(), "drill promotion must be settling"
+
+    shift.set()
+    wait_for(
+        lambda: gate_actions("slo_rollback") > base_act["slo_rollback"],
+        90, "quality-burn SLO rollback",
+    )
+    paged = [
+        o for o in ("auc_drop", "calibration_drift")
+        if engine.slo.state(o) == "page"
+    ]
+    assert "auc_drop" in paged, f"rollback without auc_drop paging: {paged}"
+    assert is_poisoned(root, drill_gen), (
+        f"{drill_gen} not poisoned on quality rollback"
+    )
+    wait_for(
+        lambda: basename(engine.model_version) == prev_primary, 30,
+        f"rollback to {prev_primary}",
+    )
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == prev_primary, "LATEST not repointed"
+    assert registry().gauge("serve_promotions_frozen").value == 1, (
+        "promotions must freeze while quality pages"
+    )
+    shift.clear()
+
+    done.set()
+    for t in producers:
+        t.join(timeout=10)
+    wall = time.perf_counter() - t_start
+    retraces = engine.retraces_since_warmup
+    stop_b.set()
+    watcher_b.join(timeout=10)
+    engine.close()  # closes the attached spool too
+
+    assert errors == 0, f"{errors} caller-visible errors"
+    assert retraces == 0, f"{retraces} retraces after warm-up"
+    assert lift >= lift_bar > 0, (fresh_auc, baseline_auc, lift)
+    decisions = {
+        a: gate_actions(a) - base_act[a]
+        for a in ("freeze", "unfreeze", "shadow_abort", "slo_rollback")
+    }
+    assert decisions["slo_rollback"] >= 1 and decisions["freeze"] >= 1
+
+    return {
+        "metric": "freshness_lift",
+        "unit": "auc",
+        "value": round(float(lift), 4),
+        "fresh_auc": round(float(fresh_auc), 4),
+        "baseline_auc": round(float(baseline_auc), 4),
+        "fresh_events": fresh_pool.count,
+        "baseline_events": base_pool.count,
+        "baseline_scored": int(baseline_scored),
+        "baseline_errors": int(baseline_errors),
+        "label_delay_p95_s": delay_p95,
+        "promotions": len(promoted),
+        "wall_s": round(wall, 3),
+        "ok": ok,
+        "caller_errors": errors,
+        "retraces": retraces,
+        "drill": {
+            "paged": paged,
+            "gate_decisions": decisions,
+            "rolled_back_generation": drill_gen,
+            "primary_after_rollback": prev_primary,
+        },
+        "smoke": smoke,
+    }
+
+
 def run_updater_shard_ab(smoke: bool = False) -> dict:
     """Sharded-updater A/B (--updater-shard-ab): the freshness plane's
     throughput must scale with updater shard count, without giving up ANY
@@ -5414,6 +5827,14 @@ def main():
         # <5% bytes per delta, shadow bit-parity, SIGKILL crash-resume
         # bit-equivalence; CPU-measurable.
         print(json.dumps(run_streaming_soak()))
+        return
+    if "--freshness-lift" in sys.argv:
+        # Measured online AUC lift of fresh-delta serving over a frozen
+        # pinned baseline under live drifting traffic, plus the
+        # quality-burn drill: injected label shift → auc_drop pages →
+        # the in-settle promotion rolls back through the unchanged SLO
+        # gate; zero caller errors, zero post-warmup retraces.
+        print(json.dumps(run_freshness_lift(smoke="--smoke" in sys.argv)))
         return
     if "--updater-shard-ab" in sys.argv:
         # Sharded streaming updaters: live traffic spooled once, replayed

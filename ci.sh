@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|fleet|rollout|streaming|exhaustion|obs|install|kernels|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|fleet|rollout|streaming|exhaustion|obs|quality|install|kernels|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -612,6 +612,25 @@ run_obs() {
     echo "   SLO rollback drill OK"
 }
 
+run_quality() {
+    # Model-quality plane (ISSUE 18): the streaming evaluator's invariants
+    # (histogram AUC within its tie bound of the exact auc_roc incl. ties
+    # and single-class windows, merge == accumulate associativity, window
+    # rotation monotone under clock skew), then the freshness-lift smoke:
+    # live drifting traffic against fresh-delta serving vs a frozen pinned
+    # baseline — measured online AUC lift must be positive, zero caller
+    # errors, zero post-warmup retraces — and the quality-burn drill: an
+    # injected label shift pages auc_drop and actuates a counted rollback
+    # + promotion freeze through the unchanged SLO gate.
+    echo "== quality: streaming evaluator unit suite =="
+    JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+        tests/test_quality.py
+    echo "   quality evaluator tests OK"
+    echo "== quality: freshness-lift smoke (lift + burn drill) =="
+    JAX_PLATFORMS=cpu python bench.py --freshness-lift --smoke
+    echo "   freshness-lift smoke OK"
+}
+
 run_kernels() {
     # Kernel-surface smoke: interpret-mode parity for both Pallas kernel
     # families (FE fused value+grad/HVP, RE batched Newton system), and a
@@ -673,6 +692,16 @@ run_install() {
     PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-game-streaming" \
         --help | grep -q -- "--route-spool"
     echo "   photon-tpu-game-streaming exposes --updater-shards/--route-spool OK"
+    # Quality-plane surfaces (ISSUE 18): late-label replay + FE-retrain
+    # actuation flags on the streaming driver, and the quality subcommand
+    # on the obs CLI.
+    PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-game-streaming" \
+        --help | grep -q -- "--late-replay-cadence"
+    PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-game-streaming" \
+        --help | grep -q -- "--fe-retrain"
+    PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-obs" \
+        quality --help > /dev/null
+    echo "   quality-plane CLI surfaces OK (--late-replay-cadence/--fe-retrain/quality)"
     rm -rf "$tmp"
 }
 
@@ -694,7 +723,8 @@ case "$stage" in
     install) run_install ;;
     kernels) run_kernels ;;
     obs) run_obs ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_fleet; run_rollout; run_streaming; run_exhaustion; run_obs; run_kernels; run_unit ;;
+    quality) run_quality ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_fleet; run_rollout; run_streaming; run_exhaustion; run_obs; run_quality; run_kernels; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

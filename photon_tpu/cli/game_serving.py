@@ -254,9 +254,16 @@ class RolloutOptions:
     backoff_max_s: float = 5.0
     # SLO actuation (--slo-gate): a paging burn on any objective in
     # slo_objectives aborts shadows / rolls back unsettled promotions and
-    # freezes further promotions until the burn clears.
+    # freezes further promotions until the burn clears. The quality
+    # objectives (auc_drop, calibration_drift) ride along by default —
+    # trackers without those rings ignore the names (record_event and
+    # _slo_paging both degrade to no-ops on unknown objectives), and
+    # trackers built with quality_objectives() make "the new model is
+    # worse" page and actuate through the same gate.
     slo_gate: bool = False
-    slo_objectives: tuple = ("availability", "latency_p99")
+    slo_objectives: tuple = (
+        "availability", "latency_p99", "auc_drop", "calibration_drift",
+    )
 
 
 def _poison(publish_root: str, version: str, reason: str) -> None:

@@ -106,6 +106,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--holdout-fraction", type=float, default=0.0,
                    help="fraction of records held out (deterministically) "
                         "for the gate's regression bound; 0 disables")
+    p.add_argument("--late-replay-cadence", type=float, default=0.0,
+                   help="seconds between late-label replay passes: the "
+                        "spool sidecar's (evicted, late_label) pairs "
+                        "re-join and retrain into a corrective delta "
+                        "through the unchanged gate; 0 disables")
+    p.add_argument("--late-replay-min-pairs", type=int, default=8,
+                   help="skip a replay pass until at least this many fresh "
+                        "joined sidecar pairs exist")
+    p.add_argument("--fe-retrain", action="store_true",
+                   help="actuate stream_fe_retrain_wanted: when the locked "
+                        "fixed effect exceeds --fe-max-age, publish a "
+                        "cooldown-guarded full generation with the FE "
+                        "coordinate unlocked (counts in "
+                        "stream_fe_retrains_total)")
+    p.add_argument("--fe-max-age", type=float, default=3600.0,
+                   help="seconds before the locked FE's age burns the "
+                        "fe_age_s objective and raises the retrain trigger")
+    p.add_argument("--fe-retrain-cooldown", type=float, default=600.0,
+                   help="minimum seconds between FE retrain attempts "
+                        "(failed attempts burn the cooldown too)")
     p.add_argument("--evaluators", nargs="*", default=["AUC"])
     p.add_argument("--metric-tolerance", type=float, default=0.02)
     p.add_argument("--norm-drift-bound", type=float, default=10.0)
@@ -232,6 +252,11 @@ def run(args) -> Dict:
                 shard_index=shard_index,
                 route_re_type=args.route_re_type,
                 pre_routed=route_spool,
+                fe_max_age_s=args.fe_max_age,
+                fe_retrain=bool(args.fe_retrain),
+                fe_retrain_cooldown_s=args.fe_retrain_cooldown,
+                late_replay_cadence_s=args.late_replay_cadence,
+                late_replay_min_pairs=args.late_replay_min_pairs,
             ),
             imaps if num_shards > 1 else index_maps,
             eidxs if num_shards > 1 else entity_indexes,

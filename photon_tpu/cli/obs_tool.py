@@ -227,6 +227,99 @@ def cmd_metrics(args: argparse.Namespace) -> int:
 
 
 # ---------------------------------------------------------------------------
+# quality
+# ---------------------------------------------------------------------------
+
+
+def quality_rows(samples: List[dict]) -> List[dict]:
+    """Fold ``quality_*`` samples into one row per metric label set
+    (model_version, tenant, re_type — plus whatever replica labels the
+    fleet merge added). The label-delay summary's quantile label is the
+    only one folded INTO a row rather than splitting rows."""
+    rows: Dict[tuple, dict] = {}
+
+    def row(labels: Dict[str, str]) -> dict:
+        ident = {k: v for k, v in labels.items() if k != "quantile"}
+        key = tuple(sorted(ident.items()))
+        return rows.setdefault(key, {"labels": ident})
+
+    for s in samples:
+        name, labels, value = s["name"], s["labels"], s["value"]
+        if name == "quality_auc":
+            row(labels)["auc"] = value
+        elif name == "quality_ece":
+            row(labels)["ece"] = value
+        elif name == "quality_auc_lift":
+            row(labels)["auc_lift"] = value
+        elif name in ("quality_logloss", "quality_deviance"):
+            row(labels)[name[len("quality_"):]] = value
+        elif name == "quality_label_delay_s":
+            q = labels.get("quantile")
+            if q == "0.5":
+                row(labels)["label_delay_p50_s"] = value
+            elif q == "0.95":
+                row(labels)["label_delay_p95_s"] = value
+        elif name == "quality_label_delay_s_count":
+            row(labels)["labels_observed"] = value
+    out = [r for r in rows.values() if len(r) > 1]
+    out.sort(key=lambda r: sorted(r["labels"].items()))
+    return out
+
+
+def cmd_quality(args: argparse.Namespace) -> int:
+    url = args.url.rstrip("/") + "/metrics"
+    try:
+        text = _get(url).decode()
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"photon-tpu-obs: {url}: {exc}", file=sys.stderr)
+        return 1
+    samples = [
+        s for s in parse_prometheus(text)
+        if s["name"].startswith("quality_")
+    ]
+    rows = quality_rows(samples)
+    if args.json:
+        print(json.dumps({"quality": rows}, indent=2))
+        return 0
+    if not rows:
+        print(
+            "(no quality_* metrics in the scrape — no labelled feedback "
+            "has reached the quality plane yet, or the window has not "
+            "met min_events)"
+        )
+        return 1
+
+    def fmt(v, digits=4):
+        return f"{v:.{digits}f}" if isinstance(v, (int, float)) else "–"
+
+    for r in rows:
+        labels = r["labels"]
+        ident = "  ".join(
+            f"{k}={labels[k]}" for k in sorted(labels) if labels[k]
+        )
+        loss = (
+            f"logloss={fmt(r['logloss'])}" if "logloss" in r
+            else f"deviance={fmt(r['deviance'])}" if "deviance" in r
+            else ""
+        )
+        print(f"{ident or '(unlabelled)'}")
+        print(
+            f"  auc={fmt(r.get('auc'))}"
+            f"  lift={fmt(r.get('auc_lift'), 4) if 'auc_lift' in r else '–'}"
+            f"  ece={fmt(r.get('ece'))}  {loss}"
+        )
+        observed = r.get("labels_observed")
+        if isinstance(observed, float):
+            observed = int(observed)
+        print(
+            f"  label_delay p50={fmt(r.get('label_delay_p50_s'), 3)}s"
+            f" p95={fmt(r.get('label_delay_p95_s'), 3)}s"
+            f"  observed={observed if observed is not None else '–'}"
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # slo
 # ---------------------------------------------------------------------------
 
@@ -339,6 +432,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="parse the exposition (labels, values, exemplars) "
                         "and print one JSON document")
     m.set_defaults(fn=cmd_metrics)
+
+    q = sub.add_parser(
+        "quality",
+        help="per-version/tenant online model quality (AUC, ECE, lift vs "
+             "baseline, label delay) from the fleet-merged /metrics scrape",
+    )
+    q.add_argument("--json", action="store_true",
+                   help="rows as one JSON document")
+    q.set_defaults(fn=cmd_quality)
 
     s = sub.add_parser("slo", help="show SLO burn state from /healthz")
     s.add_argument("--json", action="store_true",

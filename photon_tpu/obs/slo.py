@@ -118,6 +118,24 @@ def streaming_objectives(
     ]
 
 
+def quality_objectives(
+    auc_target: float = 0.99,
+    calibration_target: float = 0.99,
+) -> List[Objective]:
+    """The model-quality objectives (obs/quality.py): per-event good/bad
+    comes from the quality plane — good while the windowed online AUC stays
+    within ``auc_drop_bound`` of the frozen baseline's, and while windowed
+    ECE stays under ``ece_bound``. No per-event value threshold here: the
+    quality plane already applied its bars; these objectives only run the
+    multi-window burn machinery, so a paging ``auc_drop`` drives the SAME
+    rollout-watcher actuation (abort shadow / rollback / freeze) as any
+    operational page."""
+    return [
+        Objective("auc_drop", auc_target),
+        Objective("calibration_drift", calibration_target),
+    ]
+
+
 class _BucketRing:
     """Time-bucketed (good, bad) counts over a bounded horizon. Buckets are
     ``bucket_s`` wide; entries older than the horizon are trimmed on every

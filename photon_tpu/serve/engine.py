@@ -60,6 +60,7 @@ from photon_tpu.models.game import GameModel
 from photon_tpu.obs.metrics import registry
 from photon_tpu.obs.export import exporter_health
 from photon_tpu.obs.report import telemetry_sink_health
+from photon_tpu.obs.quality import QualityConfig, QualityPlane, task_name
 from photon_tpu.obs.slo import SLOTracker
 from photon_tpu.obs.trace import flight_recorder, tracer
 from photon_tpu.serve.admission import (
@@ -134,6 +135,37 @@ class _Breaker:
     def record_success(self) -> None:
         self.failures = 0
         self.open_until = 0.0
+
+
+def _features_from_json(features: Dict) -> Dict:
+    """Inverse of the spool's ``_jsonable_features``: dict payloads pass
+    through, 2-list (indices, values) pairs become sparse tuples, dense
+    lists become float32 vectors — the shapes ``_dense_row`` accepts."""
+    out: Dict[str, object] = {}
+    for shard, val in (features or {}).items():
+        if isinstance(val, dict):
+            out[shard] = val
+        elif (isinstance(val, (list, tuple)) and len(val) == 2
+              and isinstance(val[0], (list, tuple))):
+            out[shard] = (
+                np.asarray(val[0], np.int64),
+                np.asarray(val[1], np.float32),
+            )
+        else:
+            out[shard] = np.asarray(val, np.float32)
+    return out
+
+
+def _model_task(model: GameModel):
+    """The GLM task this model family trains (for the quality plane's
+    link/loss choice): first task found on any coordinate's model."""
+    for m in getattr(model, "models", {}).values():
+        task = getattr(m, "task", None) or getattr(
+            getattr(m, "model", None), "task", None
+        )
+        if task is not None:
+            return task
+    return None
 
 
 @dataclasses.dataclass
@@ -214,6 +246,17 @@ class ServingEngine:
         # engine (the one device-owning process); fleet replicas each run
         # their own and the scrape merges them.
         self.slo = SLOTracker()
+        # Model-quality plane (obs/quality.py): streaming AUC/calibration
+        # over the spool's joined (score, label) pairs, keyed by
+        # (model_version, tenant, re_type). ``enable_quality_baseline``
+        # adds the frozen-baseline lane (labeled traffic re-scored on a
+        # pinned generation) so freshness lift is measured, not modeled.
+        self.quality = QualityPlane(
+            QualityConfig(task=task_name(_model_task(model)))
+        )
+        self._quality_baseline: Optional[str] = None
+        self._quality_fraction = 1.0
+        self._quality_acc = 0.0  # fractional-sampling accumulator
         self._last_model_update = time.time()
         self.batcher = MicroBatcher(
             self._score_batch,
@@ -694,7 +737,7 @@ class ServingEngine:
         than drop any of those."""
         cap = max(int(self.config.max_versions), 1)
         self._maybe_settle_promotion_locked()
-        keep = {self._primary, self._shadow, protect}
+        keep = {self._primary, self._shadow, protect, self._quality_baseline}
         if self._promotion is not None:
             keep.add(self._promotion["parent"])
         for key in list(self._states):
@@ -825,6 +868,9 @@ class ServingEngine:
         completes the join. The engine owns the spool's lifecycle from here
         (closed with the engine)."""
         self._feedback = spool
+        # Every completed label join also feeds the model-quality plane
+        # (called outside the spool lock; failures count, never raise).
+        spool.on_join = self._on_feedback_join
 
     def feedback_label(
         self, uid: str, label: float, ts: Optional[float] = None
@@ -834,6 +880,98 @@ class ServingEngine:
         if self._feedback is None:
             raise ValueError("feedback spool not enabled on this engine")
         return self._feedback.observe_label(uid, label, ts)
+
+    # -- model-quality plane (obs/quality.py) -------------------------------
+
+    def enable_quality_baseline(
+        self, model_version: str, fraction: float = 1.0
+    ) -> None:
+        """Pin a resident generation as the quality plane's FROZEN
+        BASELINE: a deterministic ``fraction`` of labeled traffic is
+        re-scored on it (observability-only — a failure degrades to no
+        sample), so per-version AUC lift is the difference of two measured
+        online curves over the same requests. The version must be resident
+        and stays so: the baseline joins the never-evicted pin set
+        (primary, shadow, rollback parent) for as long as it is enabled."""
+        with self._lock:
+            key = self._resolve_version(model_version)
+        self._quality_baseline = key
+        self._quality_fraction = float(fraction)
+        self._quality_acc = 0.0
+        self.quality.set_baseline(key)
+        logger.info(
+            "serving: quality baseline pinned to %r (fraction %.3f)",
+            key, fraction,
+        )
+
+    def _on_feedback_join(self, rec: dict) -> None:
+        """One joined (score, label) record from the spool → the quality
+        plane, plus the frozen-baseline lane's re-score when enabled."""
+        ids = rec.get("entityIds") or {}
+        re_type = ",".join(sorted(ids)) if ids else ""
+        tenant = rec.get("tenant")
+        trace_id = (rec.get("trace") or {}).get("traceId")
+        label = float(rec.get("label") or 0.0)
+        self.quality.observe(
+            score=float(rec.get("score") or 0.0),
+            label=label,
+            model_version=rec.get("modelVersion"),
+            tenant=tenant,
+            re_type=re_type,
+            ts=rec.get("ts"),
+            label_ts=rec.get("labelTs"),
+            trace_id=trace_id,
+            slo=self.slo,
+        )
+        base = self._quality_baseline
+        if base is None:
+            return
+        rec_version = os.path.basename(
+            str(rec.get("modelVersion") or "").rstrip("/")
+        )
+        if rec_version == os.path.basename(str(base).rstrip("/")):
+            return  # the baseline scored it already — no second lane
+        self._quality_acc += self._quality_fraction
+        if self._quality_acc < 1.0:
+            return
+        self._quality_acc -= 1.0
+        try:
+            score = self._baseline_score(rec, base)
+        except Exception as exc:  # noqa: BLE001 — lane never hurts callers
+            registry().counter("quality_baseline_errors_total").inc()
+            logger.warning(
+                "serving: baseline quality re-score on %r failed: %s",
+                base, exc,
+            )
+            return
+        self.quality.observe(
+            score=score,
+            label=label,
+            model_version=base,
+            tenant=tenant,
+            re_type=re_type,
+            ts=rec.get("ts"),
+            label_ts=rec.get("labelTs"),
+            trace_id=trace_id,
+            slo=self.slo,  # no-op for the baseline key (plane skips it)
+        )
+        registry().counter("quality_baseline_scored_total").inc()
+
+    def _baseline_score(self, rec: dict, base: str) -> float:
+        """Score one spool record's features on the pinned baseline
+        generation, bypassing admission and the SLO request feed (an
+        internal measurement must not spend tenant quota or count against
+        availability). Shapes pad onto the warmed bucket grid, so the lane
+        keeps the zero-retrace contract."""
+        req = ScoreRequest(
+            _features_from_json(rec.get("features") or {}),
+            dict(rec.get("entityIds") or {}),
+            float(rec.get("offset") or 0.0),
+        )
+        with self._lock:
+            key = self._resolve_version(base)
+            state = self._states[key]
+            return float(self._score_on(state, [req])[0])
 
     def start_shadow(
         self, model_version: str, fraction: Optional[float] = None
@@ -1003,6 +1141,7 @@ class ServingEngine:
                 self._feedback.stats() if self._feedback is not None else None
             ),
             slo=self._slo_block(),
+            quality=self._quality_block(),
             telemetry_sink=telemetry_sink_health(),
             flight_recorder=flight_recorder().stats(),
             otlp_exporter=exporter_health(),
@@ -1019,6 +1158,16 @@ class ServingEngine:
         snap = self.slo.snapshot()
         snap["model_staleness_now_s"] = time.time() - self._last_model_update
         return snap
+
+    def _quality_block(self) -> Dict:
+        """The healthz model-quality block; also the flush point mirroring
+        windowed per-version AUC/ECE/lift into ``quality_*`` gauges so the
+        ``/metrics`` scrape (and the fleet merge) carries them."""
+        try:
+            self.quality.publish()
+        except Exception:  # noqa: BLE001 — stats must never fail on obs
+            pass
+        return self.quality.snapshot()
 
     def close(self, drain: bool = True) -> None:
         self.batcher.close(drain=drain)

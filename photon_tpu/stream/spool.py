@@ -223,6 +223,12 @@ class FeedbackSpool:
         self._late_logged_seq = -1  # once-per-segment late-label log guard
         self._late_f = None  # late-labels.jsonl sidecar, opened on first use
         self._acc: Dict[str, float] = {}  # per-tenant sampling accumulator
+        # Optional join subscriber: called with each successfully appended
+        # joined record (score + label + provenance), OUTSIDE the spool
+        # lock — the serving engine points the model-quality plane here.
+        # Containment matches everything else on this path: a subscriber
+        # failure is counted, never raised to the label caller.
+        self.on_join = None
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._closed = False
@@ -404,7 +410,14 @@ class FeedbackSpool:
             rec = dict(rec)
             rec["label"] = float(label)
             rec["labelTs"] = ts if ts is not None else time.time()
-            return self._append_locked(rec)
+            landed = self._append_locked(rec)
+        if landed and self.on_join is not None:
+            try:
+                self.on_join(rec)
+            except Exception:  # noqa: BLE001 — subscriber never hurts labels
+                registry().counter("feedback_join_subscriber_errors_total").inc()
+                logger.exception("feedback spool on_join subscriber failed")
+        return landed
 
     def _append_locked(self, rec: dict) -> bool:
         from photon_tpu.obs.metrics import registry
@@ -560,6 +573,63 @@ def _jsonable_features(features):
                 out[shard] = [float(v) for v in np.asarray(val).tolist()]
         return out
     return [float(v) for v in np.asarray(features).tolist()]
+
+
+def read_late_pairs(path: str) -> List[dict]:
+    """Re-join the late-labels sidecar: ``evicted`` lines carry the scored
+    half (features, score, modelVersion), ``late_label`` lines the label
+    that missed the join window. Matching halves (by uid) merge into full
+    spool-shaped records — the same dict :meth:`FeedbackSpool.observe_label`
+    would have appended had the label been on time. Unmatched halves are
+    left in the file and pair up on a later pass. Ordering is deterministic
+    — sorted by (labelTs, uid) — so a crashed-and-restarted replay pass
+    rebuilds the identical training batch. Malformed lines skip with a
+    counter (the sidecar is best-effort on the write side too)."""
+    from photon_tpu.obs.metrics import registry
+
+    if not os.path.exists(path):
+        return []
+    evicted: Dict[str, dict] = {}
+    labels: Dict[str, dict] = {}
+    bad = 0
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                kind = obj.get("kind")
+                if kind == "evicted" and isinstance(obj.get("record"), dict):
+                    rec = obj["record"]
+                    uid = str(rec.get("uid"))
+                    # Last write wins: a uid re-scored and re-evicted pairs
+                    # with the freshest features (file order is arrival
+                    # order, so this stays deterministic).
+                    evicted[uid] = rec
+                elif kind == "late_label" and obj.get("uid") is not None:
+                    labels[str(obj["uid"])] = obj
+                else:
+                    bad += 1
+    except OSError:
+        return []
+    if bad:
+        registry().counter("feedback_late_malformed_total").inc(bad)
+    out: List[dict] = []
+    for uid, rec in evicted.items():
+        lab = labels.get(uid)
+        if lab is None:
+            continue
+        joined = dict(rec)
+        joined["label"] = float(lab.get("label") or 0.0)
+        joined["labelTs"] = float(lab.get("labelTs") or 0.0)
+        out.append(joined)
+    out.sort(key=lambda r: (float(r.get("labelTs") or 0.0), str(r.get("uid"))))
+    return out
 
 
 def recover_orphan_parts(directory: str) -> Dict[str, int]:

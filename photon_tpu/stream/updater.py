@@ -28,7 +28,9 @@ A gate-refused generation never moves the cursor (it is not in the
 Fault site ``stream.consume`` fires once per consumed segment (labelled with
 the segment name) and once more labelled ``train`` before the solve — a
 ``kill`` rule at the right call index crashes the updater mid-generation,
-which is exactly what the resume-equivalence tests exercise.
+which is exactly what the resume-equivalence tests exercise. The late-label
+replay pass uses its own site, ``stream.replay``, so replay cadence can
+never shift ``stream.consume`` call indices out from under those tests.
 """
 
 from __future__ import annotations
@@ -44,6 +46,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_tpu.stream.spool import (
+    LATE_LABELS_FILE,
+    read_late_pairs,
     read_segment,
     recover_orphan_parts,
     sealed_segments,
@@ -158,11 +162,25 @@ class StreamingUpdaterConfig:
     # on whenever sibling shards exist. Forcing True on a single updater
     # is safe (and protects against a concurrent batch publisher).
     serialize_publish: Optional[bool] = None
-    # FE-drift trigger scaffold: the streaming plane locks the fixed
-    # effect, so its age only grows. Past this bar the ``fe_age_s`` SLO
-    # objective starts burning and the ``stream_fe_retrain_wanted`` gauge
-    # raises — wiring for a future forced full retrain, no retrain yet.
+    # FE-drift trigger: the streaming plane locks the fixed effect, so its
+    # age only grows. Past this bar the ``fe_age_s`` SLO objective starts
+    # burning and the ``stream_fe_retrain_wanted`` gauge raises; with
+    # ``fe_retrain`` on, the updater actually acts on it — a cooldown-
+    # guarded full-publish generation with the FE coordinate unlocked,
+    # trained on a bounded window of recent records.
     fe_max_age_s: float = 3600.0
+    fe_retrain: bool = False
+    fe_retrain_cooldown_s: float = 600.0
+    fe_retrain_min_records: int = 32
+    fe_retrain_window: int = 4096
+    # Late-label replay correction pass: every ``late_replay_cadence_s``
+    # seconds the updater re-joins the spool sidecar's (evicted, late_label)
+    # halves and, once at least ``late_replay_min_pairs`` fresh pairs exist,
+    # retrains the affected entities into a corrective delta published
+    # through the UNCHANGED gate. 0 disables (the default — replay is
+    # opt-in, exactly like holdout).
+    late_replay_cadence_s: float = 0.0
+    late_replay_min_pairs: int = 8
 
 
 @dataclasses.dataclass
@@ -306,6 +324,29 @@ class StreamingUpdater:
                 fe_age_threshold_s=config.fe_max_age_s
             )
         )
+        # Updater-side quality plane: holdout records (and replayed late
+        # pairs) are scored-and-labelled examples keyed by the model
+        # version that actually served them, so the training half measures
+        # online quality even with no serving engine in-process. The
+        # updater's SLO tracker carries no quality objectives by default —
+        # record_event on an unknown objective is a no-op — so this is
+        # measurement until a drill wires the rings in.
+        from photon_tpu.obs.quality import (
+            QualityConfig,
+            QualityPlane,
+            task_name,
+        )
+
+        self.quality = QualityPlane(QualityConfig(task=task_name(config.task)))
+        self._last_replay = 0.0
+        self._replay_publishes = 0
+        self._fe_retrains = 0
+        self._last_fe_retrain: Optional[float] = None
+        # Bounded window of recent train records feeding an FE retrain;
+        # only populated when the actuation is enabled.
+        from collections import deque
+
+        self._fe_recent: "deque" = deque(maxlen=max(1, config.fe_retrain_window))
 
     # -- cursor ------------------------------------------------------------
 
@@ -330,31 +371,55 @@ class StreamingUpdater:
             and int(shard.get("index", -1)) == self.config.shard_index
         )
 
-    def _cursor_stream_info(self) -> Dict:
-        """The most recent ``stream`` manifest block in the published
-        lineage that belongs to THIS worker: walk parent links from
-        ``LATEST`` and return the first matching block. A full (batch)
-        publish — or a sibling shard's micro-generation — carries no
-        matching record and is walked through; its parent chain still
-        reaches this worker's last cursor."""
+    def _stream_blocks(self):
+        """Yield the ``stream`` manifest blocks of the published lineage,
+        newest first, walking parent links from ``LATEST``. Shared by every
+        cursor lookup (segment cursor, replay-pairs cursor) so they all see
+        the same chain with the same hop bound."""
         from photon_tpu.cli.game_serving import resolve_model_dir
         from photon_tpu.io.model_io import load_generation_manifest
 
         root = self.config.publish_root
         cur = resolve_model_dir(root)
         if cur == root:
-            return {}
+            return
         for _ in range(128):
             manifest = load_generation_manifest(cur) or {}
-            stream = manifest.get("stream") or {}
-            if self._cursor_matches(stream):
-                return stream
+            yield manifest.get("stream") or {}
             parent = manifest.get("parent")
             if not parent:
-                return {}
+                return
             cur = os.path.join(root, parent)
             if not os.path.isdir(cur):
-                return {}
+                return
+
+    def _cursor_stream_info(self) -> Dict:
+        """The most recent ``stream`` manifest block in the published
+        lineage that belongs to THIS worker: the first matching block on
+        the parent walk. A full (batch) publish — or a sibling shard's
+        micro-generation — carries no matching record and is walked
+        through; its parent chain still reaches this worker's last
+        cursor."""
+        for stream in self._stream_blocks():
+            if self._cursor_matches(stream):
+                return stream
+        return {}
+
+    def _replayed_pairs(self) -> Dict[str, int]:
+        """Late-replay cursor: per-spool-dir COUNT of joined sidecar pairs
+        already folded into the lineage. Same manifest-as-cursor discipline
+        as segments — the count lands in the corrective generation's
+        ``stream.lateReplay.pairs`` block before the gate can flip LATEST,
+        so a crash before the flip deterministically re-replays the same
+        pairs and a crash after skips them. The sidecar is append-only, so
+        a pair count IS a stable prefix cursor."""
+        for stream in self._stream_blocks():
+            if not self._cursor_matches(stream):
+                continue
+            replay = stream.get("lateReplay") or {}
+            pairs = replay.get("pairs")
+            if pairs is not None:
+                return {str(k): int(v) for k, v in pairs.items()}
         return {}
 
     def consumed_through(self) -> int:
@@ -498,6 +563,13 @@ class StreamingUpdater:
             holdout_recs = [r for i, r in enumerate(records) if i % k == 0]
             if not train_recs:
                 train_recs, holdout_recs = records, []
+        if holdout_recs:
+            # Holdout records were scored by serving and never trained on —
+            # an unbiased online-quality sample keyed by the version that
+            # actually scored each one.
+            self._observe_quality(holdout_recs)
+        if cfg.fe_retrain:
+            self._fe_recent.extend(train_recs)
 
         faults.check("stream.consume", label="train")
         t_train = time.monotonic()
@@ -649,6 +721,163 @@ class StreamingUpdater:
             staleness_s=staleness,
         )
 
+    # -- model-quality plane (obs/quality.py) ------------------------------
+
+    def _observe_quality(self, records: Sequence[dict]) -> None:
+        """Feed scored-and-labelled spool records into the quality plane,
+        each keyed by the model version that actually scored it (the
+        serving engine stamped ``modelVersion`` at score time). Contained:
+        quality measurement must never fail a training cycle."""
+        try:
+            for rec in records:
+                ids = rec.get("entityIds") or {}
+                self.quality.observe(
+                    float(rec.get("score") or 0.0),
+                    float(rec.get("label") or 0.0),
+                    model_version=rec.get("modelVersion"),
+                    tenant=rec.get("tenant"),
+                    re_type=",".join(sorted(ids)) if ids else "",
+                    ts=rec.get("ts"),
+                    label_ts=rec.get("labelTs"),
+                    trace_id=(rec.get("trace") or {}).get("traceId"),
+                    slo=self.slo,
+                )
+            self.quality.publish()
+        except Exception:  # noqa: BLE001 — measurement containment
+            from photon_tpu.obs.metrics import registry
+
+            registry().counter("quality_observe_errors_total").inc()
+            logger.exception("quality-plane observe failed; cycle continues")
+
+    # -- late-label replay correction pass ---------------------------------
+
+    def maybe_replay_late_labels(self) -> Optional[CycleResult]:
+        """Cadence + containment wrapper around :meth:`replay_late_labels`.
+        Called from the driver loop every iteration; a failed replay is
+        counted and retried after the next cadence interval."""
+        cfg = self.config
+        if cfg.late_replay_cadence_s <= 0:
+            return None
+        now = time.monotonic()
+        if now - self._last_replay < cfg.late_replay_cadence_s:
+            return None
+        self._last_replay = now
+        try:
+            return self.replay_late_labels()
+        except Exception:  # noqa: BLE001 — replay containment
+            from photon_tpu.obs.metrics import registry
+
+            registry().counter("stream_replay_failures_total").inc()
+            logger.exception("late-label replay failed; will retry")
+            return None
+
+    def replay_late_labels(self) -> Optional[CycleResult]:
+        """Re-join each spool dir's ``late-labels.jsonl`` sidecar, train the
+        affected entities on the recovered (features, label) pairs, and
+        publish the result as a corrective delta through the UNCHANGED
+        gate. The per-dir count of joined pairs already consumed is the
+        cursor, persisted in the generation's ``stream.lateReplay.pairs``
+        manifest block alongside the carried-forward segment cursors — the
+        same manifest-as-cursor crash-resume discipline as segments.
+        Returns None when there are not yet enough fresh pairs."""
+        from photon_tpu.obs.metrics import registry
+        from photon_tpu.train.incremental import incremental_update
+
+        cfg = self.config
+        dirs = discover_spool_dirs(cfg.spool_dir)
+        consumed_pairs = self._replayed_pairs()
+        new_pairs = dict(consumed_pairs)
+        fresh: List[dict] = []
+        for d in dirs:
+            pairs = read_late_pairs(os.path.join(d, LATE_LABELS_FILE))
+            if not pairs:
+                continue
+            key = spool_dir_key(d)
+            done = min(consumed_pairs.get(key, 0), len(pairs))
+            new_pairs[key] = len(pairs)
+            fresh.extend(pairs[done:])
+        if self._ring is not None and not cfg.pre_routed and fresh:
+            # Sharded plane: train only the rows this shard's ring slice
+            # owns. The pair cursor still counts ALL pairs — each shard's
+            # replay chain is shard-tagged, so siblings keep their own.
+            from photon_tpu.stream.shard_router import owned_records
+
+            fresh = owned_records(
+                fresh, self._ring, cfg.shard_index, cfg.route_re_type
+            )
+        if len(fresh) < max(1, cfg.late_replay_min_pairs):
+            return None
+        faults.check("stream.replay", label="train")
+        reg = registry()
+        t_train = time.monotonic()
+        batch = records_to_batch(
+            fresh, self.index_maps, self.entity_indexes, intern=True
+        )
+        cursors = self.consumed_per_spool()
+        multi = len(dirs) > 1 or is_spool_glob(cfg.spool_dir)
+        stream_info: Dict = {
+            _CURSOR_KEY: max(cursors.values(), default=0),
+            "lateReplay": {"pairs": new_pairs, "records": len(fresh)},
+        }
+        if multi:
+            stream_info[_PER_SPOOL_KEY] = cursors
+        if cfg.num_shards > 1:
+            stream_info["shard"] = {
+                "index": cfg.shard_index,
+                "of": cfg.num_shards,
+            }
+        serialize = cfg.serialize_publish
+        if serialize is None:
+            serialize = cfg.num_shards > 1
+        result = incremental_update(
+            cfg.publish_root,
+            batch,
+            self.index_maps,
+            self.entity_indexes,
+            cfg.task,
+            cfg.coordinate_configs,
+            cfg.update_sequence,
+            locked_coordinates=list(cfg.locked_coordinates),
+            num_iterations=cfg.num_iterations,
+            metric_tolerance=cfg.metric_tolerance,
+            norm_drift_bound=cfg.norm_drift_bound,
+            re_convergence_tol=cfg.re_convergence_tol,
+            emit_delta=bool(cfg.delta_artifacts),
+            extra_manifest={"stream": stream_info},
+            serialize_publish=bool(serialize),
+        )
+        self._train_s += time.monotonic() - t_train
+        if result.published:
+            self._publishes += 1
+            self._replay_publishes += 1
+            self._records_trained += len(fresh)
+            reg.counter("stream_late_replays_total").inc()
+            reg.counter("stream_late_replayed_pairs_total").inc(len(fresh))
+            # The recovered cohort is scored-and-labelled — measure it, so
+            # the correction's lift is attributable in the quality plane.
+            self._observe_quality(fresh)
+            logger.info(
+                "late-label replay published %s: %d recovered pairs",
+                result.generation, len(fresh),
+            )
+        else:
+            reg.counter("stream_gate_rejects_total").inc()
+            logger.warning(
+                "late-label replay generation %s refused by the gate (%s); "
+                "pairs stay unconsumed and retry next cadence",
+                result.generation, result.gate_reason,
+            )
+        return CycleResult(
+            generation=result.generation,
+            published=result.published,
+            is_delta=result.is_delta,
+            gate_reason=result.gate_reason,
+            segments=[],
+            records=len(fresh),
+            consumed_through=max(cursors.values(), default=0),
+            staleness_s=None,
+        )
+
     # -- FE-drift trigger scaffold ----------------------------------------
 
     def fe_age_s(self) -> Optional[float]:
@@ -691,9 +920,9 @@ class StreamingUpdater:
     def _observe_fe_age(self, reg) -> None:
         """Feed the ``fe_age_s`` objective (same multi-window burn
         machinery as staleness) and raise ``stream_fe_retrain_wanted``
-        while the locked FE is past its age bar. Wiring only: nothing
-        consumes the gauge yet — a future PR points a forced full retrain
-        at it."""
+        while the locked FE is past its age bar. With ``fe_retrain`` on the
+        raised gauge actuates a cooldown-guarded FE full retrain instead of
+        just asking for one."""
         age = self.fe_age_s()
         if age is None:
             return
@@ -706,6 +935,107 @@ class StreamingUpdater:
                 "locked fixed effect is %.0fs old (bar %.0fs): "
                 "stream_fe_retrain_wanted raised", age,
                 self.config.fe_max_age_s,
+            )
+            self._maybe_fe_retrain(reg, age)
+
+    def _maybe_fe_retrain(self, reg, age: float) -> None:
+        """Actuate the raised retrain-wanted gauge: cooldown-guarded, floor
+        on accumulated records, contained. The cooldown stamp is taken
+        BEFORE the attempt so a failing retrain cannot hot-loop — it burns
+        its cooldown like a successful one and the failure is counted."""
+        cfg = self.config
+        if not cfg.fe_retrain:
+            return
+        now = time.monotonic()
+        if (
+            self._last_fe_retrain is not None
+            and now - self._last_fe_retrain < cfg.fe_retrain_cooldown_s
+        ):
+            return
+        recs = list(self._fe_recent)
+        if len(recs) < max(1, cfg.fe_retrain_min_records):
+            return
+        self._last_fe_retrain = now
+        try:
+            self._run_fe_retrain(reg, recs, age)
+        except Exception:  # noqa: BLE001 — actuation containment
+            reg.counter("stream_fe_retrain_failures_total").inc()
+            logger.exception(
+                "FE full retrain failed; cooldown %.0fs still applies",
+                cfg.fe_retrain_cooldown_s,
+            )
+
+    def _run_fe_retrain(self, reg, recs: List[dict], age: float) -> None:
+        """One FE full-retrain generation: the recent-record window trains
+        with the fixed-effect coordinates UNLOCKED and publishes full
+        (``emit_delta=False``) so the new generation persists FE
+        coefficients — which is exactly what resets ``fe_age_s`` and drops
+        the wanted gauge. Same gate, same manifest-as-cursor discipline
+        (segment cursors carry forward; no segments are consumed here)."""
+        from photon_tpu.train.incremental import incremental_update
+
+        cfg = self.config
+        fe_ids = {
+            getattr(c, "coordinate_id", None)
+            for c in cfg.coordinate_configs
+            if getattr(c, "re_type", None) is None
+        }
+        locked = [c for c in cfg.locked_coordinates if c not in fe_ids]
+        batch = records_to_batch(
+            recs, self.index_maps, self.entity_indexes, intern=True
+        )
+        cursors = self.consumed_per_spool()
+        multi = (
+            len(discover_spool_dirs(cfg.spool_dir)) > 1
+            or is_spool_glob(cfg.spool_dir)
+        )
+        stream_info: Dict = {
+            _CURSOR_KEY: max(cursors.values(), default=0),
+            "feRetrain": {"records": len(recs), "ageS": round(age, 3)},
+        }
+        if multi:
+            stream_info[_PER_SPOOL_KEY] = cursors
+        if cfg.num_shards > 1:
+            stream_info["shard"] = {
+                "index": cfg.shard_index,
+                "of": cfg.num_shards,
+            }
+        serialize = cfg.serialize_publish
+        if serialize is None:
+            serialize = cfg.num_shards > 1
+        t_train = time.monotonic()
+        result = incremental_update(
+            cfg.publish_root,
+            batch,
+            self.index_maps,
+            self.entity_indexes,
+            cfg.task,
+            cfg.coordinate_configs,
+            cfg.update_sequence,
+            locked_coordinates=locked,
+            num_iterations=cfg.num_iterations,
+            metric_tolerance=cfg.metric_tolerance,
+            norm_drift_bound=cfg.norm_drift_bound,
+            re_convergence_tol=cfg.re_convergence_tol,
+            emit_delta=False,
+            extra_manifest={"stream": stream_info},
+            serialize_publish=bool(serialize),
+        )
+        self._train_s += time.monotonic() - t_train
+        if result.published:
+            self._publishes += 1
+            self._fe_retrains += 1
+            reg.counter("stream_fe_retrains_total").inc()
+            reg.gauge("stream_fe_retrain_wanted").set(0.0)
+            logger.info(
+                "FE full retrain published %s (%d records, FE was %.0fs "
+                "old)", result.generation, len(recs), age,
+            )
+        else:
+            reg.counter("stream_gate_rejects_total").inc()
+            logger.warning(
+                "FE retrain generation %s refused by the gate (%s)",
+                result.generation, result.gate_reason,
             )
 
     # -- driver loop -------------------------------------------------------
@@ -726,6 +1056,7 @@ class StreamingUpdater:
                 self.slo.record_event("update_cycle", False)
                 logger.exception("streaming update cycle failed; retrying")
                 result = None
+            self.maybe_replay_late_labels()
             self.slo.publish_metrics()
             if result is not None:
                 done += 1
@@ -745,7 +1076,10 @@ class StreamingUpdater:
             "busy_s": self._busy_s,
             "train_s": self._train_s,
             "records_trained": self._records_trained,
+            "late_replays": self._replay_publishes,
+            "fe_retrains": self._fe_retrains,
             "slo": self.slo.snapshot(),
+            "quality": self.quality.snapshot(),
         }
         if self.config.num_shards > 1:
             out["shard"] = {

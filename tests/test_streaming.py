@@ -672,9 +672,13 @@ def test_updater_publishes_delta_and_moves_cursor(tmp_path):
     }
     assert st.pop("busy_s") > 0.0
     assert st.pop("train_s") > 0.0
+    # The quality plane saw the deterministic holdout slice (none here:
+    # holdout_fraction defaults off in this config), and neither
+    # correction pass ran.
+    assert st.pop("quality")["task"] == "logistic"
     assert st == {
         "cycles": 1, "publishes": 1, "consumed_through": 2,
-        "records_trained": 16,
+        "records_trained": 16, "late_replays": 0, "fe_retrains": 0,
     }
 
 
@@ -1373,3 +1377,153 @@ def test_pre_routed_workers_match_read_side_filtering(tmp_path):
         assert a.consumed_through() == b.consumed_through() == 4
         assert (a.stats()["records_trained"]
                 == b.stats()["records_trained"])
+
+
+def _late_pair_lines(n, entities, seed, ts0=5000.0):
+    """n (evicted, late_label) line pairs in sidecar shape, spool-record
+    shaped halves — what TTL eviction + a late observe_label write."""
+    r = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        e = entities[i % len(entities)]
+        rec = {
+            "ts": ts0 + i,
+            "uid": f"late{seed}-{i}",
+            "tenant": None,
+            "features": {
+                "global": [float(v) for v in r.normal(size=D_FIX)],
+                "per_user": [float(v) for v in r.normal(size=D_RE)],
+            },
+            "entityIds": {"userId": f"user{e}"},
+            "offset": 0.0,
+            "score": 0.25,
+            "modelVersion": "gen-1",
+        }
+        lines.append({"kind": "evicted", "record": rec})
+        lines.append({
+            "kind": "late_label", "uid": rec["uid"],
+            "label": float(i % 2), "labelTs": ts0 + 100.0 + i,
+        })
+    return lines
+
+
+def _append_sidecar(sdir, lines):
+    from photon_tpu.stream.spool import LATE_LABELS_FILE
+
+    os.makedirs(sdir, exist_ok=True)
+    with open(os.path.join(sdir, LATE_LABELS_FILE), "a") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+
+
+def test_updater_replays_late_labels(tmp_path):
+    """The correction pass end to end: side-spooled (evicted, late_label)
+    pairs re-join, the affected entities retrain from the side-spool, and
+    a corrective DELTA publishes through the unchanged gate with the
+    joined-pair count as a manifest cursor (``stream.lateReplay``) — so a
+    re-run replays nothing, and new pairs below the floor wait."""
+    from photon_tpu.io.model_io import (
+        delta_info,
+        load_generation_manifest,
+    )
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.stream.updater import spool_dir_key
+
+    root, sdir = str(tmp_path / "pub"), str(tmp_path / "spool")
+    os.makedirs(root)
+    w1, imaps, eidx = _updater_root(root)
+    _append_sidecar(sdir, _late_pair_lines(8, [0, 1], seed=61))
+    upd = _updater(root, sdir, imaps, eidx,
+                   late_replay_cadence_s=0.01, late_replay_min_pairs=4)
+
+    replays0 = registry().counter("stream_late_replays_total").value
+    pairs0 = registry().counter("stream_late_replayed_pairs_total").value
+    res = upd.replay_late_labels()
+    assert res is not None and res.published and res.is_delta
+    assert res.records == 8 and res.segments == []
+    key = spool_dir_key(sdir)
+    man = load_generation_manifest(os.path.join(root, res.generation))
+    assert man["parent"] == "gen-1"
+    assert man["stream"]["lateReplay"] == {"pairs": {key: 8}, "records": 8}
+    assert delta_info(os.path.join(root, res.generation))
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == res.generation
+    # Only the affected entities moved; everything else rides the delta.
+    re_now = _resolved_re(root, imaps, eidx)
+    np.testing.assert_array_equal(re_now[2:], w1[2:])
+    assert np.abs(re_now[:2] - w1[:2]).max() > 0
+    assert registry().counter("stream_late_replays_total").value == replays0 + 1
+    assert (registry().counter("stream_late_replayed_pairs_total").value
+            == pairs0 + 8)
+    # The recovered cohort is measured: the quality plane holds all 8
+    # pairs under the version that scored them, so the correction's lift
+    # is attributable.
+    qsnap = upd.stats()["quality"]
+    assert [v for v in qsnap["versions"]
+            if v["model_version"] == "gen-1"][0]["count"] == 8
+
+    # Cursor discipline: the same sidecar replays nothing...
+    assert upd.replay_late_labels() is None
+    # ...a fresh updater resumes from the manifest, not memory...
+    assert _updater(root, sdir, imaps, eidx,
+                    late_replay_cadence_s=0.01,
+                    late_replay_min_pairs=4).replay_late_labels() is None
+    # ...pairs below the floor wait, and the next batch past it publishes
+    # with the cursor advanced to the TOTAL pair count.
+    _append_sidecar(sdir, _late_pair_lines(2, [2], seed=62))
+    assert upd.replay_late_labels() is None
+    _append_sidecar(sdir, _late_pair_lines(2, [3], seed=63, ts0=6000.0))
+    res2 = upd.replay_late_labels()
+    assert res2 is not None and res2.published and res2.records == 4
+    man2 = load_generation_manifest(os.path.join(root, res2.generation))
+    assert man2["stream"]["lateReplay"]["pairs"] == {key: 12}
+    assert upd.stats()["late_replays"] == 2
+
+
+def test_updater_fe_retrain_actuates(tmp_path):
+    """With ``fe_retrain`` on, a raised ``stream_fe_retrain_wanted`` gauge
+    actuates: the recent-record window retrains with the FE UNLOCKED and
+    publishes a FULL generation (which is what resets FE age), under a
+    cooldown so a sticky age bar cannot hot-loop publishes."""
+    from photon_tpu.io.model_io import (
+        delta_info,
+        load_generation_manifest,
+        load_resolved_game_model,
+    )
+    from photon_tpu.obs.metrics import registry
+
+    root, sdir = str(tmp_path / "pub"), str(tmp_path / "spool")
+    os.makedirs(root)
+    _, imaps, eidx = _updater_root(root)
+    _write_segment(sdir, 1, _segment_records(8, [0, 1], seed=97))
+    upd = _updater(root, sdir, imaps, eidx,
+                   fe_max_age_s=1e-9, fe_retrain=True,
+                   fe_retrain_cooldown_s=3600.0, fe_retrain_min_records=4)
+
+    retrains0 = registry().counter("stream_fe_retrains_total").value
+    res = upd.run_once()
+    assert res is not None and res.published and res.is_delta
+    # The cycle's delta publish aged past the (instant) bar and actuated:
+    # one extra FULL generation beyond the delta, FE unlocked.
+    assert registry().counter("stream_fe_retrains_total").value == retrains0 + 1
+    assert upd.stats()["fe_retrains"] == 1
+    assert registry().gauge("stream_fe_retrain_wanted").value == 0.0
+    with open(os.path.join(root, "LATEST")) as f:
+        latest = f.read().strip()
+    man = load_generation_manifest(os.path.join(root, latest))
+    assert man["stream"]["feRetrain"]["records"] == 8
+    assert man["stream"]["consumedThrough"] == 1  # cursor carried forward
+    assert delta_info(os.path.join(root, latest)) is None  # FULL publish
+    # The FE moved — it was unlocked for this generation only.
+    child = load_resolved_game_model(
+        os.path.join(root, latest), imaps, {"userId": eidx}, to_device=False
+    )
+    fe = np.asarray(child.models["global"].model.coefficients.means)
+    assert np.abs(fe - np.linspace(-1, 1, D_FIX).astype(np.float32)).max() > 0
+
+    # Cooldown: the bar is still expired next cycle, but nothing retrains.
+    _write_segment(sdir, 2, _segment_records(8, [2], seed=98))
+    res2 = upd.run_once()
+    assert res2 is not None and res2.published
+    assert registry().counter("stream_fe_retrains_total").value == retrains0 + 1
+    assert upd.stats()["fe_retrains"] == 1
