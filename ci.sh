@@ -533,6 +533,15 @@ run_fleet() {
     echo "== fleet: 3-replica parity + kill/rejoin + fleet admission =="
     JAX_PLATFORMS=cpu python bench.py --fleet-soak --fleet-smoke
     echo "   fleet-soak smoke OK"
+    # Cross-host transport drill: the same frame protocol over TCP
+    # loopback with the HMAC handshake, warm shard handoff through a
+    # live join AND drain (per-replica hit rate holds — no cold dip, no
+    # FE-only window), a SIGKILL+revive with zero caller errors, zero
+    # post-warmup retraces, and the probe set bit-identical over TCP,
+    # Unix sockets, and the batch engine.
+    echo "== fleet: TCP transport parity + warm shard handoff =="
+    JAX_PLATFORMS=cpu python bench.py --fleet-handoff --fleet-smoke
+    echo "   fleet-handoff smoke OK"
 }
 
 run_rollout() {

@@ -316,6 +316,10 @@ class RandomEffectCoordinate(Coordinate):
     # algorithm/re_store.ReDeviceStore. None → fully resident (default).
     device_budget_bytes: Optional[int] = None
     device_spill_dir: Optional[str] = None
+    # Host-owned spill layout: with a member id, spill files live under
+    # ``<device_spill_dir>/host-<k>/`` (re_store.partition_spill_dir) so a
+    # ring rebalance moves files instead of re-streaming rows.
+    device_spill_member: Optional[str] = None
     # Newton-system assembly lowering for the per-entity solves
     # (ops/pallas_newton.RE_KERNELS): "auto" picks the fused batched Pallas
     # kernel on a real TPU backend and XLA elsewhere; "pallas" /
@@ -388,6 +392,7 @@ class RandomEffectCoordinate(Coordinate):
                     self.coordinate_id,
                     self.device_spill_dir,
                     device=self.device,
+                    spill_member=self.device_spill_member,
                 )
                 # Drop the device references: from here on the dataset's
                 # blocks ARE the host master, and device placement happens

@@ -37,6 +37,7 @@ Invariants this store must preserve (the hard part of the design):
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import threading
@@ -61,6 +62,99 @@ _BLOCK_FIELDS = (
     "sample_index",
     "train_mask",
 )
+
+
+def spill_partition_tag(member) -> str:
+    """Stable short tag naming a ring partition's spill directory. Ints and
+    ``name:k`` members use the integer (``updater:3`` → ``3`` — the tag a
+    shard worker, the manifest, and the metrics already agree on); any other
+    member id gets a short blake2b hex so arbitrary replica ids still map
+    to a filesystem-safe, process-stable name."""
+    if isinstance(member, int):
+        return str(member)
+    m = str(member)
+    tail = m.rsplit(":", 1)[-1]
+    if tail.isdigit():
+        return tail
+    return hashlib.blake2b(m.encode("utf-8"), digest_size=4).hexdigest()
+
+
+def partition_spill_dir(spill_root: str, member) -> str:
+    """Per-ring-partition spill directory: ``<spill_root>/host-<k>/``.
+
+    The host-owned layout makes rebalance a RENAME problem instead of a
+    row-streaming problem: every out-of-core host master spilled for ring
+    partition ``k`` lives under one directory, so when a ring change hands
+    the partition to a different owner on the same filesystem, adopting its
+    spilled state is ``os.replace`` on a handful of files — no row
+    re-stream, no decode, no re-encode. Placement here is a LOCALITY hint
+    only; ownership is always re-derived from the ring (serve/store.py's
+    owned mask, the updater's ``owned_records``), so a mis-located file can
+    cost a cold read but never a wrong answer."""
+    path = os.path.join(spill_root, f"host-{spill_partition_tag(member)}")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def rebalance_spill_layout(spill_root: str, before, after) -> Dict[str, Dict]:
+    """Move departed ring members' spill partitions to their successors by
+    file rename — the host-owned layout's payoff.
+
+    ``before``/``after`` are :class:`~photon_tpu.serve.routing.HashRing`
+    instances (or anything with ``members`` and ``owner``). For each member
+    present before but not after, its ``host-<k>/`` files are adopted by
+    the member owning the departed id's hash on the AFTER ring — a
+    deterministic successor every process derives identically. Files move
+    with ``os.replace`` (an inode rename on one filesystem, never a data
+    copy); a name collision in the successor's directory keeps both by
+    prefixing the adopted file with ``from-<k>__``. Returns per-departed
+    stats ``{member: {"successor": str, "moved": int}}``.
+
+    Caveat (by design): the successor of a departed member's NAME hash is
+    not necessarily the ring owner of every entity in its files — after a
+    move, some adopted rows are foreign to their new directory. That is
+    safe because spill placement is a locality hint (see
+    :func:`partition_spill_dir`); the next compaction pass re-homes rows
+    exactly. The move buys warm disk locality for the common case at
+    rename cost, instead of exact re-homing at re-stream cost."""
+    out: Dict[str, Dict] = {}
+    survivors = set(after.members)
+    for member in before.members:
+        if member in survivors:
+            continue
+        src = os.path.join(
+            spill_root, f"host-{spill_partition_tag(member)}"
+        )
+        if not os.path.isdir(src):
+            continue
+        successor = after.owner(str(member))
+        if successor is None:
+            continue
+        dst = partition_spill_dir(spill_root, successor)
+        moved = 0
+        for name in sorted(os.listdir(src)):
+            src_path = os.path.join(src, name)
+            if not os.path.isfile(src_path):
+                continue
+            dst_path = os.path.join(dst, name)
+            if os.path.exists(dst_path):
+                dst_path = os.path.join(
+                    dst,
+                    f"from-{spill_partition_tag(member)}__{name}",
+                )
+            os.replace(src_path, dst_path)
+            moved += 1
+        try:
+            os.rmdir(src)
+        except OSError:
+            pass  # non-file leftovers keep the dir; harmless
+        registry().counter("re_spill_rebalance_moves_total").inc(moved)
+        logger.info(
+            "re_store spill rebalance: %s -> %s (%d files renamed)",
+            member, successor, moved,
+        )
+        out[str(member)] = dict(successor=str(successor), moved=moved)
+    return out
 
 
 def host_entity_block(
@@ -138,9 +232,17 @@ class ReDeviceStore:
         coordinate_id: str,
         spill_dir: Optional[str] = None,
         device=None,
+        spill_member=None,
     ):
-        if spill_dir is not None:
+        # ``spill_member`` opts into the host-owned per-ring-partition
+        # layout: spill files land under ``<spill_dir>/host-<k>/`` so a
+        # ring rebalance is a file move (rebalance_spill_layout), not a
+        # row re-stream.
+        if spill_dir is not None and spill_member is not None:
+            spill_dir = partition_spill_dir(spill_dir, spill_member)
+        elif spill_dir is not None:
             os.makedirs(spill_dir, exist_ok=True)
+        self.spill_dir = spill_dir
         self.coordinate_id = coordinate_id
         # Entity-sharded placement (parallel/entity_shard.py): every upload
         # pins to this device so the working set stays local to the shard's

@@ -31,7 +31,6 @@ already-compiled allocations.
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -179,11 +178,6 @@ class ShardedRandomEffectCoordinate(Coordinate):
                 int(plan.counts[s]),
                 config,
             )
-            spill = (
-                os.path.join(device_spill_dir, f"shard{s}")
-                if device_spill_dir is not None
-                else None
-            )
             shards.append(
                 RandomEffectCoordinate(
                     coordinate_id=f"{coordinate_id}/shard{s}",
@@ -195,7 +189,13 @@ class ShardedRandomEffectCoordinate(Coordinate):
                     active_set=active_set,
                     convergence_tol=convergence_tol,
                     device_budget_bytes=device_budget_bytes,
-                    device_spill_dir=spill,
+                    # Host-owned spill layout: shard s's master lives under
+                    # ``<spill>/host-<s>/`` so a shard-count rebalance is a
+                    # file move (re_store.rebalance_spill_layout).
+                    device_spill_dir=device_spill_dir,
+                    device_spill_member=(
+                        s if device_spill_dir is not None else None
+                    ),
                     re_kernel=re_kernel,
                     device=dev,
                 )

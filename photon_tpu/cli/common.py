@@ -201,6 +201,14 @@ def add_out_of_core_args(p: argparse.ArgumentParser) -> None:
              "(default: host RAM); only meaningful with "
              "--re-device-budget-mb",
     )
+    p.add_argument(
+        "--re-spill-member", default=None,
+        help="ring-member tag for the host-owned spill layout: spill "
+             "files land under <re-spill-dir>/host-<k>/ so a fleet "
+             "rebalance is a file move, not a row re-stream (see "
+             "re_store.rebalance_spill_layout); only meaningful with "
+             "--re-spill-dir",
+    )
 
 
 def add_validation_arg(p: argparse.ArgumentParser) -> None:

@@ -185,6 +185,7 @@ def run(args) -> Dict:
         re_convergence_tol=args.re_convergence_tol,
         re_device_budget_mb=args.re_device_budget_mb,
         re_spill_dir=args.re_spill_dir,
+        re_spill_member=args.re_spill_member,
         dead_letters=read_dead_letters(args.dead_letter_in),
         publish=not args.no_publish,
     )

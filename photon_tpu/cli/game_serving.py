@@ -93,6 +93,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "server (tests/smoke). N>0 forks N parse/accept "
                         "workers sharing one listen socket, relaying over a "
                         "Unix socket to this device-owning scorer process")
+    p.add_argument("--scorer-endpoint", default=None,
+                   help="override the worker->scorer relay endpoint: a "
+                        "filesystem path (Unix socket, the default: a "
+                        "tempdir socket) or tcp://host:port for a "
+                        "cross-host scorer. TCP needs an explicit port "
+                        "(workers fork before the scorer binds) and the "
+                        "shared secret in $PHOTON_TPU_FLEET_SECRET — "
+                        "never on argv")
     p.add_argument("--max-batch-size", type=int, default=64,
                    help="micro-batch row cap; rounded UP onto the bucket_dim "
                         "shape grid so warm-up covers every dispatch shape")
@@ -732,7 +740,10 @@ def _run_multiprocess(args):
     scorer IPC socket from this process."""
     from photon_tpu.obs import begin_run, finalize_run_report
 
-    frontend = ServingFrontend(args.host, args.port, args.workers)
+    frontend = ServingFrontend(
+        args.host, args.port, args.workers,
+        scorer_endpoint=args.scorer_endpoint,
+    )
     frontend.fork_workers()  # before any jax init, see ServingFrontend
     stop = threading.Event()
 

@@ -131,6 +131,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--norm-drift-bound", type=float, default=10.0)
     p.add_argument("--coordinate-descent-iterations", type=int, default=1)
     p.add_argument("--re-convergence-tol", type=float, default=1e-4)
+    p.add_argument(
+        "--re-device-budget-mb", type=float, default=None,
+        help="device byte budget for random-effect block data during "
+             "per-cycle fits (out-of-core residency; None = fully "
+             "resident)",
+    )
+    p.add_argument(
+        "--re-spill-dir", default=None,
+        help="spill root for the out-of-core host master; sharded "
+             "updaters spill under host-<shard>/ (host-owned layout) so "
+             "a shard-count rebalance is a file move, not a re-stream "
+             "(shard_router.rebalance_updater_spill)",
+    )
     p.add_argument("--telemetry-out", default=None)
     p.add_argument("--otlp-endpoint", default=None,
                    help="base URL of an OTLP/HTTP collector accepting JSON; "
@@ -248,6 +261,8 @@ def run(args) -> Dict:
                 norm_drift_bound=args.norm_drift_bound,
                 num_iterations=args.coordinate_descent_iterations,
                 re_convergence_tol=args.re_convergence_tol,
+                re_device_budget_mb=args.re_device_budget_mb,
+                re_spill_dir=args.re_spill_dir,
                 num_shards=num_shards,
                 shard_index=shard_index,
                 route_re_type=args.route_re_type,

@@ -425,6 +425,7 @@ def run(args) -> Dict:
         re_convergence_tol=args.re_convergence_tol,
         re_device_budget_mb=args.re_device_budget_mb,
         re_spill_dir=args.re_spill_dir,
+        re_spill_member=args.re_spill_member,
     )
     from photon_tpu.utils.events import training_finish_event, training_start_event
 

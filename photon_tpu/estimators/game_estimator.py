@@ -124,6 +124,7 @@ class GameEstimator:
         re_convergence_tol: float = 1e-4,
         re_device_budget_mb: Optional[float] = None,
         re_spill_dir: Optional[str] = None,
+        re_spill_member: Optional[str] = None,
     ):
         self.task = task
         self.coordinate_configs = list(coordinate_configs)
@@ -154,6 +155,10 @@ class GameEstimator:
             else None
         )
         self.re_spill_dir = re_spill_dir
+        # Host-owned spill layout: when set, spill files land under
+        # ``<re_spill_dir>/host-<k>/`` so a fleet rebalance moves files
+        # instead of re-streaming rows (re_store.rebalance_spill_layout).
+        self.re_spill_member = re_spill_member
         if self.ignore_threshold_for_new_models and warm_start_model is None:
             raise ValueError(
                 "'Ignore threshold for new models' flag set but no initial "
@@ -226,6 +231,7 @@ class GameEstimator:
                     ),
                     device_budget_bytes=self.re_device_budget_bytes,
                     device_spill_dir=self.re_spill_dir,
+                    device_spill_member=self.re_spill_member,
                 )
             else:
                 raise TypeError(f"unknown coordinate config {type(cfg)}")

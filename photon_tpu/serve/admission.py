@@ -239,6 +239,49 @@ class AdmissionController:
             return out
 
 
+def tenant_quality(quality_snapshots) -> Dict[str, Dict]:
+    """Reduce QualityPlane snapshots (one per scorer replica) to the
+    per-tenant quality keys the admission ledger surfaces: count-weighted
+    ``quality_auc`` / ``auc_lift`` across every (model_version, re_type)
+    cell the tenant appears in. The frozen-baseline lane is excluded — it
+    is the yardstick the lift is measured against, not a tenant's live
+    quality."""
+    agg: Dict[str, Dict] = {}
+    for snap in quality_snapshots:
+        if not isinstance(snap, dict):
+            continue
+        baseline = snap.get("baseline")
+        for entry in snap.get("versions") or []:
+            if baseline and entry.get("model_version") == baseline:
+                continue
+            tenant = entry.get("tenant") or DEFAULT_TENANT
+            n = int(entry.get("count") or 0)
+            if n <= 0:
+                continue
+            a = agg.setdefault(
+                tenant,
+                dict(n=0, auc_w=0.0, auc_n=0, lift_w=0.0, lift_n=0),
+            )
+            a["n"] += n
+            auc = entry.get("auc")
+            if auc is not None:
+                a["auc_w"] += float(auc) * n
+                a["auc_n"] += n
+            lift = entry.get("auc_lift")
+            if lift is not None:
+                a["lift_w"] += float(lift) * n
+                a["lift_n"] += n
+    out: Dict[str, Dict] = {}
+    for tenant, a in agg.items():
+        rec: Dict = dict(observations=a["n"])
+        if a["auc_n"]:
+            rec["quality_auc"] = round(a["auc_w"] / a["auc_n"], 6)
+        if a["lift_n"]:
+            rec["auc_lift"] = round(a["lift_w"] / a["lift_n"], 6)
+        out[tenant] = rec
+    return out
+
+
 class FleetAdmissionLedger(AdmissionController):
     """Fleet-global admission: ONE token-bucket ledger for the whole scorer
     fleet, living in the routing front end (single-coordinator model — the
@@ -264,6 +307,7 @@ class FleetAdmissionLedger(AdmissionController):
     ):
         super().__init__(config=config, clock=clock)
         self._inflight: Dict[str, int] = {}
+        self._quality: Dict[str, Dict] = {}
 
     def begin(self, replica_id: str) -> None:
         with self._lock:
@@ -282,6 +326,27 @@ class FleetAdmissionLedger(AdmissionController):
             if replica_id is not None:
                 return self._inflight.get(replica_id, 0)
             return sum(self._inflight.values())
+
+    def update_quality(self, per_tenant: Optional[Dict[str, Dict]]) -> None:
+        """Install the latest per-tenant quality rollup (see
+        :func:`tenant_quality`); merged into :meth:`snapshot` so the fleet
+        ``/healthz`` tenants block reports admission AND model quality for
+        each caller side by side."""
+        with self._lock:
+            self._quality = {
+                str(t): dict(v) for t, v in (per_tenant or {}).items()
+            }
+
+    def snapshot(self) -> Dict[str, Dict]:
+        out = super().snapshot()
+        with self._lock:
+            quality = {t: dict(v) for t, v in self._quality.items()}
+        for tenant, rec in quality.items():
+            out.setdefault(
+                tenant,
+                dict(admitted=0, shed=0, qps_limit=None, burst=None),
+            ).update(rec)
+        return out
 
     def fleet_snapshot(self) -> Dict:
         """Tenant quota state + per-replica in-flight depth for the fleet

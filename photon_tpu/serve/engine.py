@@ -1108,6 +1108,35 @@ class ServingEngine:
             versions=sorted(self._states),
         )
 
+    def shard_export(
+        self,
+        target_snapshot: Dict,
+        target_member: Optional[str] = None,
+        include_cold: bool = True,
+    ) -> Dict:
+        """Warm-handoff export from the PRIMARY generation's store (the one
+        live traffic resolves against), serialized with scoring on the
+        batch lock — see ``HotColdEntityStore.shard_export``."""
+        with self._lock:
+            return self._state.store.shard_export(
+                target_snapshot,
+                target_member=target_member,
+                include_cold=include_cold,
+            )
+
+    def shard_import(self, payload: Dict) -> Dict:
+        """Install a peer's handoff payload on EVERY resident generation's
+        store (host rows + hot-set pre-promotion) under the batch lock.
+        Upload chunks stay within the warmed scatter buckets, so the
+        zero-post-warmup-retrace contract holds through a handoff."""
+        out: Dict = {}
+        with self._lock:
+            for version, state in self._states.items():
+                out[version] = state.store.shard_import(
+                    payload, upload_chunk=self.max_batch
+                )
+        return out
+
     def stats(self) -> Dict:
         state = self._state
         degraded = sorted(

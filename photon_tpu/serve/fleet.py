@@ -38,6 +38,7 @@ import json
 import logging
 import os
 import signal
+import socket as socket_mod
 import subprocess
 import sys
 import threading
@@ -48,6 +49,12 @@ from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence
 
 from photon_tpu.obs.metrics import registry, render_prometheus
+from photon_tpu.obs.slo import (
+    DRILL_PAGE_RULES,
+    DRILL_WARN_RULES,
+    Objective,
+    SLOTracker,
+)
 from photon_tpu.obs.trace import (
     TraceContext,
     flight_recorder,
@@ -59,13 +66,16 @@ from photon_tpu.serve.admission import (
     INTERACTIVE,
     AdmissionConfig,
     FleetAdmissionLedger,
+    tenant_quality,
 )
 from photon_tpu.serve.batcher import BackpressureError
 from photon_tpu.serve.frontend import (
+    FLEET_SECRET_ENV,
     ScorerClient,
     ScorerServer,
     _stamp_labels,
     make_http_handler,
+    parse_endpoint,
 )
 from photon_tpu.serve.routing import HashRing, route_key
 from photon_tpu.serve.store import StorePartition
@@ -124,6 +134,11 @@ class ReplicaScorerServer(ScorerServer):
         self.route_re_type = route_re_type
         self.compact_host = compact_host
         self.ring_version: Optional[int] = None
+        # Split-brain guard: which router id last (successfully) claimed a
+        # ring epoch on this replica. A DIFFERENT router pushing the same or
+        # an older epoch is two coordinators fighting over one fleet — the
+        # push is rejected and flagged so the routers' SLO planes can page.
+        self.ring_claimant: Optional[str] = None
 
     def _dispatch(self, msg: dict, out) -> None:
         rid = msg.get("id")
@@ -131,6 +146,29 @@ class ReplicaScorerServer(ScorerServer):
         if op == "ring":
             try:
                 snap = msg.get("snapshot") or {}
+                router_id = msg.get("routerId")
+                version = int(snap.get("version", 0))
+                if (
+                    router_id is not None
+                    and self.ring_claimant is not None
+                    and router_id != self.ring_claimant
+                    and self.ring_version is not None
+                    and version <= self.ring_version
+                ):
+                    registry().counter("fleet_split_brain_total").inc()
+                    logger.error(
+                        "fleet replica %s: SPLIT BRAIN — router %s pushed "
+                        "ring v%d but router %s already claims v%d; "
+                        "rejecting",
+                        self.replica_id, router_id, version,
+                        self.ring_claimant, self.ring_version,
+                    )
+                    out.put(dict(id=rid, ok=True, result=dict(
+                        splitBrain=True, rejected=True,
+                        claimant=self.ring_claimant,
+                        ringVersion=self.ring_version,
+                    )))
+                    return
                 partition = partition_from_snapshot(
                     self.replica_id,
                     snap,
@@ -139,12 +177,33 @@ class ReplicaScorerServer(ScorerServer):
                 )
                 info = self.engine.set_partition(partition)
                 self.ring_version = partition.ring.version
+                if router_id is not None:
+                    self.ring_claimant = str(router_id)
                 logger.info(
                     "fleet replica %s: installed ring v%s (%d members)",
                     self.replica_id, partition.ring.version,
                     len(partition.ring),
                 )
+                info = dict(info, splitBrain=False)
                 out.put(dict(id=rid, ok=True, result=info))
+            except Exception as exc:  # noqa: BLE001 — per-request failure
+                out.put(self._error_payload(rid, exc))
+            return
+        if op == "shard_export":
+            try:
+                out.put(dict(id=rid, ok=True, result=self.engine.shard_export(
+                    msg.get("snapshot") or {},
+                    target_member=msg.get("targetMember"),
+                    include_cold=bool(msg.get("includeCold", True)),
+                )))
+            except Exception as exc:  # noqa: BLE001 — per-request failure
+                out.put(self._error_payload(rid, exc))
+            return
+        if op == "shard_import":
+            try:
+                out.put(dict(id=rid, ok=True, result=self.engine.shard_import(
+                    msg.get("payload") or {},
+                )))
             except Exception as exc:  # noqa: BLE001 — per-request failure
                 out.put(self._error_payload(rid, exc))
             return
@@ -154,6 +213,7 @@ class ReplicaScorerServer(ScorerServer):
                     replica=self.replica_id,
                     pid=os.getpid(),
                     ringVersion=self.ring_version,
+                    ringClaimant=self.ring_claimant,
                     partition=self.engine.stats().get("partition"),
                 )))
             except Exception as exc:  # noqa: BLE001 — per-request failure
@@ -171,7 +231,10 @@ def _replica_argparser() -> argparse.ArgumentParser:
         description="One scorer-fleet replica: a ServingEngine owning the "
         "ring shard of its --replica-id, served over a framed Unix socket.",
     )
-    p.add_argument("--socket", required=True)
+    p.add_argument("--socket", required=True,
+                   help="framed-IPC endpoint: a Unix socket path, or "
+                   "tcp://host:port for the cross-host transport (the "
+                   f"shared secret rides ${FLEET_SECRET_ENV}, never argv)")
     p.add_argument("--replica-id", required=True)
     p.add_argument("--model-dir", required=True)
     p.add_argument("--artifacts-dir", default=None)
@@ -255,7 +318,9 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
     # established by the router's retry-connect, not by parsing this).
     print(json.dumps(dict(
         event="ready", replica=args.replica_id, pid=os.getpid(),
-        socket=args.socket, ringVersion=partition.ring.version,
+        # server.socket_path, not args.socket: a tcp://host:0 bind
+        # advertises the resolved port.
+        socket=server.socket_path, ringVersion=partition.ring.version,
         partition=engine.stats().get("partition"),
     )), flush=True)
 
@@ -298,12 +363,29 @@ class FleetRouter:
         route_re_type: Optional[str] = None,
         queue_cap: int = 1024,
         result_timeout_s: float = 120.0,
+        router_id: Optional[str] = None,
+        secret: Optional[str] = None,
     ):
         self.ring = ring
         self.ledger = ledger
         self.route_re_type = route_re_type
         self.queue_cap = int(queue_cap)
         self.result_timeout_s = result_timeout_s
+        # Stable per-router identity for the split-brain guard: every ring
+        # push carries it, and a replica that already follows a DIFFERENT
+        # router for this epoch rejects the push and says so.
+        self.router_id = router_id or (
+            f"router-{os.getpid()}-{os.urandom(3).hex()}"
+        )
+        self.secret = secret
+        # Drill-scale burn windows: a sustained split-brain pages within
+        # seconds (the same state machine the serve SLOs run).
+        self.slo = SLOTracker(
+            objectives=[Objective("fleet_split_brain", 0.999)],
+            page_rules=DRILL_PAGE_RULES,
+            warn_rules=DRILL_WARN_RULES,
+            min_events=1,
+        )
         self._lock = threading.RLock()
         self._clients: Dict[str, ScorerClient] = {}
         self._state: Dict[str, str] = {}
@@ -316,7 +398,8 @@ class FleetRouter:
         connect_timeout_s: float = 180.0,
     ) -> ScorerClient:
         """Connect (retrying while the replica warms) and mark live."""
-        client = ScorerClient(socket_path, connect_timeout_s)
+        client = ScorerClient(socket_path, connect_timeout_s,
+                              secret=self.secret)
         with self._lock:
             old = self._clients.get(replica_id)
             self._clients[replica_id] = client
@@ -418,12 +501,16 @@ class FleetRouter:
             return
         registry().counter("fleet_requests_total", replica=replica_id).inc()
         self.ledger.begin(replica_id)
+        t0 = time.monotonic()
         try:
             src = client.submit_score(
                 raw_request, tenant, priority, model_version, trace=trace
             )
         except ConnectionError as exc:
             self.ledger.end(replica_id)
+            registry().counter(
+                "fleet_rpc_errors_total", replica=replica_id
+            ).inc()
             self._on_conn_lost(replica_id)
             self._advance(
                 raw_request, tenant, priority, model_version, trace,
@@ -433,8 +520,14 @@ class FleetRouter:
 
         def _done(f: Future) -> None:
             self.ledger.end(replica_id)
+            registry().histogram(
+                "fleet_rpc_latency_s", replica=replica_id, op="score"
+            ).observe(time.monotonic() - t0)
             exc = f.exception()
             if isinstance(exc, ConnectionError):
+                registry().counter(
+                    "fleet_rpc_errors_total", replica=replica_id
+                ).inc()
                 # The replica died with this request in flight. Scoring is
                 # read-only → safe to replay on the next live candidate.
                 self._on_conn_lost(replica_id)
@@ -488,20 +581,48 @@ class FleetRouter:
 
     # -- control plane ------------------------------------------------------
 
+    def rpc_call(
+        self, replica_id: str, op: str, timeout_s: float = 30.0, **payload
+    ):
+        """One timed control-plane RPC to a member: every call lands in the
+        per-peer ``fleet_rpc_latency_s{replica,op}`` histogram, every
+        failure in ``fleet_rpc_errors_total{replica}`` — the two signals a
+        cross-host deployment alerts on. Raises on failure (callers decide
+        whether a member failing the op is fatal)."""
+        client = self.client(replica_id)
+        if client is None:
+            raise ConnectionError(f"replica {replica_id} not attached")
+        t0 = time.monotonic()
+        try:
+            res = client.call(op, timeout_s=timeout_s, **payload)
+        except Exception:
+            registry().counter(
+                "fleet_rpc_errors_total", replica=replica_id
+            ).inc()
+            raise
+        finally:
+            registry().histogram(
+                "fleet_rpc_latency_s", replica=replica_id, op=op
+            ).observe(time.monotonic() - t0)
+        return res
+
     def broadcast_ring(self, timeout_s: float = 120.0) -> Dict[str, dict]:
         """Push the current ring snapshot to every live replica (each
         rebuilds its partition predicate in place). Returns per-replica
-        results; a member failing the push is marked dead."""
+        results; a member failing the push is marked dead. Each reply
+        feeds the ``fleet_split_brain`` SLO objective: a replica that
+        rejects this router's claim because ANOTHER router owns the epoch
+        is a bad event, and a sustained burn of those pages."""
         snap = self.ring.snapshot()
         out: Dict[str, dict] = {}
         for replica_id in self.live_members():
-            client = self.client(replica_id)
-            if client is None:
+            if self.client(replica_id) is None:
                 continue
             try:
-                out[replica_id] = client.call(
-                    "ring", timeout_s=timeout_s,
+                res = self.rpc_call(
+                    replica_id, "ring", timeout_s=timeout_s,
                     snapshot=snap, routeReType=self.route_re_type,
+                    routerId=self.router_id,
                 )
             except Exception as exc:  # noqa: BLE001 — per-member failure
                 logger.warning(
@@ -509,18 +630,37 @@ class FleetRouter:
                 )
                 self._on_conn_lost(replica_id)
                 out[replica_id] = dict(error=str(exc))
+                continue
+            split = bool((res or {}).get("splitBrain"))
+            self.slo.record_event("fleet_split_brain", good=not split)
+            if split:
+                logger.error(
+                    "fleet: replica %s rejected ring v%d — epoch claimed "
+                    "by router %s (split brain)",
+                    replica_id, snap.get("version"),
+                    (res or {}).get("claimant"),
+                )
+            out[replica_id] = res
         return out
 
     def replica_stats(self, timeout_s: float = 30.0) -> Dict[str, dict]:
         out: Dict[str, dict] = {}
         for replica_id in self.live_members():
-            client = self.client(replica_id)
-            if client is None:
+            if self.client(replica_id) is None:
                 continue
             try:
-                out[replica_id] = client.call("stats", timeout_s=timeout_s)
+                out[replica_id] = self.rpc_call(
+                    replica_id, "stats", timeout_s=timeout_s
+                )
             except Exception as exc:  # noqa: BLE001 — per-member failure
                 out[replica_id] = dict(error=str(exc))
+        try:
+            self.ledger.update_quality(tenant_quality(
+                res.get("quality")
+                for res in out.values() if isinstance(res, dict)
+            ))
+        except Exception:  # noqa: BLE001 — stats must never fail on obs
+            pass
         return out
 
     def replica_metrics(self, timeout_s: float = 30.0) -> Dict[str, dict]:
@@ -532,14 +672,15 @@ class FleetRouter:
         fleet as the whole one."""
         out: Dict[str, dict] = {}
         for replica_id in self.live_members():
-            client = self.client(replica_id)
-            if client is None:
+            if self.client(replica_id) is None:
                 out[replica_id] = dict(ok=False, error="not attached")
                 continue
             try:
                 out[replica_id] = dict(
                     ok=True,
-                    metrics=client.call("metrics", timeout_s=timeout_s) or [],
+                    metrics=self.rpc_call(
+                        replica_id, "metrics", timeout_s=timeout_s
+                    ) or [],
                 )
             except Exception as exc:  # noqa: BLE001 — per-member failure
                 out[replica_id] = dict(ok=False, error=str(exc))
@@ -553,13 +694,13 @@ class FleetRouter:
         nothing — trace dumps are diagnostics, not bookkeeping."""
         entries: List[dict] = []
         for replica_id in self.live_members():
-            client = self.client(replica_id)
-            if client is None:
+            if self.client(replica_id) is None:
                 continue
             try:
                 entries.extend(
-                    client.call("traces", timeout_s=timeout_s, limit=limit)
-                    or []
+                    self.rpc_call(
+                        replica_id, "traces", timeout_s=timeout_s, limit=limit
+                    ) or []
                 )
             except Exception:  # noqa: BLE001 — per-member failure
                 pass
@@ -567,14 +708,21 @@ class FleetRouter:
 
     def fleet_snapshot(self) -> dict:
         """The ``/healthz`` ``fleet`` block: ring version, per-replica
-        shard ranges, member states, and the global admission ledger."""
+        shard ranges, member states, the global admission ledger, and this
+        router's identity + split-brain SLO state."""
+        try:
+            self.slo.publish_metrics()
+        except Exception:  # noqa: BLE001 — stats must never fail on obs
+            pass
         return dict(
             ringVersion=self.ring.version,
+            routerId=self.router_id,
             members=self.ring.members,
             states=self.states(),
             routeReType=self.route_re_type,
             shardRanges=self.ring.shard_ranges(),
             admission=self.ledger.fleet_snapshot(),
+            slo=self.slo.snapshot(),
         )
 
 
@@ -823,6 +971,18 @@ class FleetHTTPFrontend:
 # ---------------------------------------------------------------------------
 
 
+def _free_port() -> int:
+    """Reserve a loopback TCP port (bind-0, read, release). The replica
+    re-binds it with SO_REUSEADDR moments later; the window is the same one
+    every ephemeral-port test harness accepts."""
+    s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
 class ScorerFleet:
     """Owns the replica subprocesses and the elastic-membership protocol.
 
@@ -854,7 +1014,12 @@ class ScorerFleet:
         connect_timeout_s: float = 300.0,
         heartbeat_s: float = 0.25,
         replica_env: Optional[Dict[str, Dict[str, str]]] = None,
+        transport: str = "unix",
+        secret: Optional[str] = None,
+        weights: Optional[Dict[str, int]] = None,
     ):
+        if transport not in ("unix", "tcp"):
+            raise ValueError(f"transport must be unix|tcp, got {transport!r}")
         self.model_dir = model_dir
         self.artifacts_dir = artifacts_dir
         self.workdir = workdir
@@ -867,15 +1032,23 @@ class ScorerFleet:
         self.compact_host = compact_host
         self.connect_timeout_s = connect_timeout_s
         self.heartbeat_s = float(heartbeat_s)
+        self.transport = transport
+        # TCP needs the shared handshake secret on both ends; generate one
+        # for loopback fleets when the environment doesn't provide it.
+        if transport == "tcp" and not secret:
+            secret = os.environ.get(FLEET_SECRET_ENV) or os.urandom(16).hex()
+        self.secret = secret
+        self._endpoints: Dict[str, str] = {}
         # Per-replica extra environment — how a drill targets ONE replica
         # with a PHOTON_TPU_FAULT_PLAN kill rule.
         self.replica_env = dict(replica_env or {})
         os.makedirs(workdir, exist_ok=True)
-        self.ring = HashRing(vnodes=vnodes, seed=seed)
+        self.ring = HashRing(vnodes=vnodes, seed=seed, weights=weights)
         self.ledger = FleetAdmissionLedger(admission)
         self.router = FleetRouter(
             self.ring, self.ledger, route_re_type,
             queue_cap=queue_cap, result_timeout_s=result_timeout_s,
+            secret=self.secret if transport == "tcp" else None,
         )
         self._procs: Dict[str, subprocess.Popen] = {}
         self._logs: Dict[str, object] = {}
@@ -883,6 +1056,15 @@ class ScorerFleet:
     # -- plumbing -----------------------------------------------------------
 
     def socket_path(self, replica_id: str) -> str:
+        """The replica's framed-IPC endpoint: a workdir Unix socket path,
+        or (``transport="tcp"``) a loopback ``tcp://`` endpoint with a port
+        reserved at first use — the SAME frame protocol either way."""
+        if self.transport == "tcp":
+            ep = self._endpoints.get(replica_id)
+            if ep is None:
+                ep = f"tcp://127.0.0.1:{_free_port()}"
+                self._endpoints[replica_id] = ep
+            return ep
         return os.path.join(self.workdir, f"scorer-{replica_id}.sock")
 
     def log_path(self, replica_id: str) -> str:
@@ -911,6 +1093,8 @@ class ScorerFleet:
             cmd += ["--no-compact-host"]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if self.transport == "tcp" and self.secret:
+            env[FLEET_SECRET_ENV] = self.secret
         # The replica must import photon_tpu no matter the caller's cwd:
         # put the package's parent dir on its path explicitly.
         import photon_tpu
@@ -953,31 +1137,122 @@ class ScorerFleet:
             )
         return self
 
-    def join(self, replica_id: str) -> None:
+    def join(
+        self,
+        replica_id: str,
+        warm: bool = True,
+        weight: Optional[int] = None,
+    ) -> None:
         """Elastic join: the newcomer warms with the POST-join ring (its
         partition is right from birth), traffic flips only once it is
-        connectable, then the incumbents re-partition. During the gap,
-        keys the new ring reassigns score FE-only on their old owner —
-        degraded, never failed."""
-        future_ring = HashRing(
-            members=self.ring.members + [replica_id],
-            vnodes=self.ring.vnodes, seed=self.ring.seed,
-            version=self.ring.version + 1,
-        )
-        self._spawn(replica_id, future_ring.snapshot())
+        connectable, then the incumbents re-partition.
+
+        ``warm=True`` additionally streams each incumbent's HOT rows for
+        the keys the new ring reassigns to the newcomer — BEFORE the ring
+        flips — so the newcomer's first requests hit a warm cache instead
+        of paying a cold-start miss storm (the join-side degradation
+        window). ``warm=False`` is the measured-for-contrast cold path."""
+        future_ring = HashRing.from_snapshot(self.ring.snapshot())
+        future_ring.add(replica_id, weight=weight)
+        future_snap = future_ring.snapshot()
+        self._spawn(replica_id, future_snap)
         self.router.attach(
             replica_id, self.socket_path(replica_id), self.connect_timeout_s
         )
-        self.ring.add(replica_id)  # same version the newcomer already holds
+        if warm:
+            self._warm_handoff_to(replica_id, future_snap, include_cold=False)
+        self.ring.add(replica_id, weight=weight)  # newcomer already holds it
         self.router.broadcast_ring()
         logger.info("fleet: %s joined (ring v%d)", replica_id,
                     self.ring.version)
 
-    def leave(self, replica_id: str, settle_s: float = 30.0) -> None:
+    def _warm_handoff_to(
+        self, newcomer: str, future_snap: dict, include_cold: bool
+    ) -> None:
+        """Stream every incumbent's handoff payload for ``newcomer`` (its
+        owned entities moving there under ``future_snap``). Best-effort: a
+        member failing its export degrades THAT slice to the cold path —
+        membership changes must never hinge on a warm-up RPC."""
+        t0 = time.monotonic()
+        moved = dict(rows=0, promoted=0)
+        for member in self.router.live_members():
+            if member == newcomer:
+                continue
+            try:
+                payload = self.router.rpc_call(
+                    member, "shard_export", timeout_s=120.0,
+                    snapshot=future_snap, targetMember=newcomer,
+                    includeCold=include_cold,
+                )
+                if not (payload or {}).get("groups"):
+                    continue
+                res = self.router.rpc_call(
+                    newcomer, "shard_import", timeout_s=120.0,
+                    payload=payload,
+                )
+                for stats in (res or {}).values():
+                    moved["rows"] += int(stats.get("rowsAdded", 0))
+                    moved["promoted"] += int(stats.get("promoted", 0))
+            except Exception as exc:  # noqa: BLE001 — best-effort warm-up
+                logger.warning(
+                    "fleet: warm handoff %s->%s failed (cold for that "
+                    "slice): %s", member, newcomer, exc,
+                )
+        logger.info(
+            "fleet: warm handoff to %s: %d rows, %d pre-promoted (%.2fs)",
+            newcomer, moved["rows"], moved["promoted"],
+            time.monotonic() - t0,
+        )
+
+    def leave(
+        self, replica_id: str, settle_s: float = 30.0, warm: bool = True,
+    ) -> None:
         """Graceful leave, same settle discipline as the rollout watcher:
         stop routing new work to the member, wait for its in-flight count
         to drain (bounded by ``settle_s``), re-partition the survivors,
-        then SIGTERM (the replica's own drain finishes anything left)."""
+        then SIGTERM (the replica's own drain finishes anything left).
+
+        ``warm=True`` first streams the leaver's shard to its new owners,
+        grouped per survivor under the post-leave ring — host rows AND the
+        hot set. Without it, compacted survivors have no host rows for the
+        inherited keys and serve them FE-only until a reload (the drain
+        degradation window this kills)."""
+        future_ring = HashRing.from_snapshot(self.ring.snapshot())
+        if replica_id in future_ring:
+            future_ring.remove(replica_id)
+        future_snap = future_ring.snapshot()
+        if warm and replica_id in self.ring:
+            t0 = time.monotonic()
+            moved = dict(rows=0, promoted=0)
+            for survivor in self.router.live_members():
+                if survivor == replica_id:
+                    continue
+                try:
+                    payload = self.router.rpc_call(
+                        replica_id, "shard_export", timeout_s=120.0,
+                        snapshot=future_snap, targetMember=survivor,
+                        includeCold=True,
+                    )
+                    if not (payload or {}).get("groups"):
+                        continue
+                    res = self.router.rpc_call(
+                        survivor, "shard_import", timeout_s=120.0,
+                        payload=payload,
+                    )
+                    for stats in (res or {}).values():
+                        moved["rows"] += int(stats.get("rowsAdded", 0))
+                        moved["promoted"] += int(stats.get("promoted", 0))
+                except Exception as exc:  # noqa: BLE001 — best-effort
+                    logger.warning(
+                        "fleet: warm handoff %s->%s failed (FE-only for "
+                        "that slice until reload): %s",
+                        replica_id, survivor, exc,
+                    )
+            logger.info(
+                "fleet: drain handoff from %s: %d rows, %d pre-promoted "
+                "(%.2fs)", replica_id, moved["rows"], moved["promoted"],
+                time.monotonic() - t0,
+            )
         self.router.mark(replica_id, DRAINING)
         deadline = time.monotonic() + settle_s
         while (

@@ -38,6 +38,8 @@ has exactly one classification function for both deployment shapes.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import logging
 import os
@@ -80,6 +82,10 @@ logger = logging.getLogger("photon_tpu")
 
 _LEN = struct.Struct(">I")
 MAX_FRAME_BYTES = 64 << 20
+
+# Shared secret for the TCP transport's HMAC handshake. Environment, never
+# argv: command lines are world-readable via /proc.
+FLEET_SECRET_ENV = "PHOTON_TPU_FLEET_SECRET"
 
 
 # ---------------------------------------------------------------------------
@@ -250,6 +256,91 @@ def _recv_frame(sock: socket.socket) -> Optional[dict]:
 
 
 # ---------------------------------------------------------------------------
+# Transport endpoints: Unix paths and tcp://host:port
+# ---------------------------------------------------------------------------
+
+
+def parse_endpoint(endpoint: str):
+    """``("unix", path)`` for a plain filesystem path, ``("tcp", (host,
+    port))`` for a ``tcp://host:port`` URL. Everything above the socket —
+    the frame protocol, op table, trace propagation — is family-agnostic."""
+    if endpoint.startswith("tcp://"):
+        hostport = endpoint[len("tcp://"):]
+        host, sep, port = hostport.rpartition(":")
+        if not sep:
+            raise ValueError(f"tcp endpoint needs host:port, got {endpoint!r}")
+        return "tcp", (host or "127.0.0.1", int(port))
+    return "unix", endpoint
+
+
+def _hmac_hex(secret: str, message: str) -> str:
+    return hmac.new(
+        secret.encode(), message.encode(), hashlib.sha256
+    ).hexdigest()
+
+
+def _auth_server(conn: socket.socket, secret: str) -> bool:
+    """Server half of the mutual challenge/response handshake, first frames
+    on the connection: we challenge with a fresh per-connection nonce, the
+    peer answers HMAC-SHA256(secret, nonce) plus its own nonce, and we prove
+    ourselves back over that — so neither side ever sends the secret, and a
+    recorded handshake can't be replayed against either end."""
+    lock = threading.Lock()
+    nonce = os.urandom(16).hex()
+    try:
+        conn.settimeout(10.0)
+        _send_frame(conn, dict(op="auth_challenge", nonce=nonce), lock)
+        msg = _recv_frame(conn)
+        got = str((msg or {}).get("mac", ""))
+        if not hmac.compare_digest(_hmac_hex(secret, nonce), got):
+            registry().counter("fleet_auth_failures_total").inc()
+            _send_frame(conn, dict(op="auth_fail"), lock)
+            return False
+        peer_nonce = str((msg or {}).get("nonce", ""))
+        _send_frame(
+            conn, dict(op="auth_ok", mac=_hmac_hex(secret, peer_nonce)), lock
+        )
+        conn.settimeout(None)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def _auth_client(sock: socket.socket, secret: str) -> None:
+    """Client half: answer the server's challenge, then verify the server's
+    proof over OUR nonce before trusting anything it frames back. A MAC
+    mismatch raises ``PermissionError`` — callers must not retry it the way
+    they retry a not-yet-listening endpoint."""
+    lock = threading.Lock()
+    sock.settimeout(10.0)
+    msg = _recv_frame(sock)
+    if not msg or msg.get("op") != "auth_challenge":
+        raise ConnectionError("scorer endpoint did not issue auth challenge")
+    nonce = os.urandom(16).hex()
+    _send_frame(
+        sock,
+        dict(
+            op="auth_response",
+            mac=_hmac_hex(secret, str(msg.get("nonce", ""))),
+            nonce=nonce,
+        ),
+        lock,
+    )
+    reply = _recv_frame(sock)
+    if (
+        not reply
+        or reply.get("op") != "auth_ok"
+        or not hmac.compare_digest(
+            _hmac_hex(secret, nonce), str(reply.get("mac", ""))
+        )
+    ):
+        raise PermissionError(
+            "fleet transport auth failed (shared secret mismatch)"
+        )
+    sock.settimeout(None)
+
+
+# ---------------------------------------------------------------------------
 # Scorer side (the one device-owning process)
 # ---------------------------------------------------------------------------
 
@@ -261,9 +352,19 @@ class ScorerServer:
     complete out of order via the engine futures' done-callbacks, so a
     single connection carries arbitrarily many in-flight requests."""
 
-    def __init__(self, engine, socket_path: str):
+    def __init__(self, engine, socket_path: str, secret: Optional[str] = None):
         self.engine = engine
         self.socket_path = socket_path
+        self._family = parse_endpoint(socket_path)[0]
+        if secret is None and self._family == "tcp":
+            secret = os.environ.get(FLEET_SECRET_ENV)
+        if self._family == "tcp" and not secret:
+            raise ValueError(
+                "TCP scorer endpoints require a shared secret "
+                f"(set ${FLEET_SECRET_ENV}) — refusing to listen "
+                "unauthenticated off-host"
+            )
+        self.secret = secret
         self._sock: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
@@ -271,11 +372,18 @@ class ScorerServer:
         self._closed = False
 
     def start(self) -> None:
-        if os.path.exists(self.socket_path):
-            os.unlink(self.socket_path)
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(self.socket_path)
-        self._sock.listen(128)
+        fam, addr = parse_endpoint(self.socket_path)
+        if fam == "unix":
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(self.socket_path)
+            self._sock.listen(128)
+        else:
+            self._sock = socket.create_server(addr, backlog=128)
+            host, port = self._sock.getsockname()[:2]
+            # Re-resolve so a port-0 bind advertises the real port.
+            self.socket_path = f"tcp://{host}:{port}"
         t = threading.Thread(
             target=self._accept_loop, name="scorer-accept", daemon=True
         )
@@ -289,6 +397,13 @@ class ScorerServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # listener closed
+            if self._family == "tcp":
+                try:
+                    conn.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                except OSError:
+                    pass
             with self._lock:
                 if self._closed:
                     conn.close()
@@ -302,6 +417,12 @@ class ScorerServer:
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self.secret is not None and not _auth_server(conn, self.secret):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
         out: "queue.Queue[Optional[dict]]" = queue.Queue()
         wlock = threading.Lock()
 
@@ -482,7 +603,7 @@ class ScorerServer:
                 pass
         for t in self._threads:
             t.join(timeout=5.0)
-        if os.path.exists(self.socket_path):
+        if self._family == "unix" and os.path.exists(self.socket_path):
             try:
                 os.unlink(self.socket_path)
             except OSError:
@@ -500,24 +621,50 @@ class ScorerClient:
     (or raising the reconstructed engine exception); a lost connection
     fails every in-flight future with ``ConnectionError``."""
 
-    def __init__(self, socket_path: str, connect_timeout_s: float = 120.0):
+    def __init__(
+        self,
+        socket_path: str,
+        connect_timeout_s: float = 120.0,
+        secret: Optional[str] = None,
+    ):
+        fam, addr = parse_endpoint(socket_path)
+        if secret is None and fam == "tcp":
+            secret = os.environ.get(FLEET_SECRET_ENV)
+        self.endpoint = socket_path
         deadline = time.monotonic() + connect_timeout_s
         last_err: Optional[BaseException] = None
+        delay = 0.05  # capped exponential backoff while the scorer warms
         while True:
+            sock: Optional[socket.socket] = None
             try:
-                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                sock.connect(socket_path)
+                if fam == "unix":
+                    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    sock.connect(addr)
+                else:
+                    sock = socket.create_connection(addr, timeout=10.0)
+                    sock.settimeout(None)
+                    sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                if secret is not None:
+                    _auth_client(sock, secret)
                 break
+            except PermissionError:
+                # Wrong shared secret: retrying can't fix it.
+                if sock is not None:
+                    sock.close()
+                raise
             except OSError as exc:
                 last_err = exc
-                sock.close()
+                if sock is not None:
+                    sock.close()
                 if time.monotonic() >= deadline:
                     raise ConnectionError(
-                        f"scorer socket {socket_path} not reachable after "
+                        f"scorer endpoint {socket_path} not reachable after "
                         f"{connect_timeout_s:.0f}s: {last_err}"
                     ) from last_err
-                # The scorer is still warming the model; keep retrying.
-                time.sleep(0.05)
+                time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+                delay = min(delay * 2.0, 1.0)
         self._sock = sock
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
@@ -998,7 +1145,8 @@ class ServingFrontend:
     the engine)."""
 
     def __init__(self, host: str, port: int, num_workers: int,
-                 backlog: int = 128):
+                 backlog: int = 128,
+                 scorer_endpoint: Optional[str] = None):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         self.num_workers = int(num_workers)
@@ -1007,7 +1155,23 @@ class ServingFrontend:
         )
         self.host, self.port = self._listen_sock.getsockname()[:2]
         self._scorer_dir = tempfile.mkdtemp(prefix="photon-serve-")
-        self.scorer_path = os.path.join(self._scorer_dir, "scorer.sock")
+        if scorer_endpoint is None:
+            self.scorer_path = os.path.join(self._scorer_dir, "scorer.sock")
+        else:
+            fam = parse_endpoint(scorer_endpoint)[0]
+            if fam == "tcp":
+                # Workers fork (and start connecting) BEFORE the scorer
+                # binds, so a tcp endpoint must name its port up front —
+                # there is no post-bind channel to hand a kernel-assigned
+                # port back to the children. The shared secret rides
+                # $PHOTON_TPU_FLEET_SECRET (never argv: /proc/*/cmdline
+                # is world-readable).
+                if parse_endpoint(scorer_endpoint)[1][1] == 0:
+                    raise ValueError(
+                        "tcp scorer endpoints need an explicit port "
+                        "(workers fork before the scorer binds)"
+                    )
+            self.scorer_path = scorer_endpoint
         self.pids: List[int] = []
         self._live: Dict[int, bool] = {}
         self.worker_exits: Dict[int, int] = {}

@@ -63,12 +63,20 @@ class HashRing:
         vnodes: int = 64,
         seed: int = 0,
         version: int = 0,
+        weights: Optional[Dict[str, int]] = None,
     ):
         if vnodes < 1:
             raise ValueError(f"vnodes must be >= 1, got {vnodes}")
         self.vnodes = int(vnodes)
         self.seed = int(seed)
         self.version = int(version)
+        # Per-member vnode counts for heterogeneous hosts: a member with
+        # weight 2 places 2×vnodes points and owns ~2× the hash space.
+        # Members absent from the map get the default count, so old
+        # snapshots (no ``weights`` key) rebuild bit-identically.
+        self._weights: Dict[str, int] = {
+            str(m): int(w) for m, w in (weights or {}).items()
+        }
         self._members: List[str] = []
         self._points: List[Tuple[int, str]] = []  # sorted (hash, member)
         self._hashes: List[int] = []
@@ -77,18 +85,29 @@ class HashRing:
 
     # -- membership --------------------------------------------------------
 
+    def member_vnodes(self, member: str) -> int:
+        """Virtual-point count for ``member``: ``vnodes × weight``."""
+        w = self._weights.get(str(member), 1)
+        if w < 1:
+            raise ValueError(f"member weight must be >= 1, got {w}")
+        return self.vnodes * w
+
     def _insert(self, member: str) -> None:
         if member in self._members:
             raise ValueError(f"ring member {member!r} already present")
         self._members.append(member)
-        for v in range(self.vnodes):
+        for v in range(self.member_vnodes(member)):
             h = stable_hash(f"{member}#{v}", self.seed)
             bisect.insort(self._points, (h, member))
         self._hashes = [h for h, _ in self._points]
 
-    def add(self, member: str) -> int:
-        """Add a member; returns the new ring version."""
-        self._insert(str(member))
+    def add(self, member: str, weight: Optional[int] = None) -> int:
+        """Add a member (optionally weighted); returns the new ring
+        version."""
+        member = str(member)
+        if weight is not None:
+            self._weights[member] = int(weight)
+        self._insert(member)
         self.version += 1
         return self.version
 
@@ -176,12 +195,20 @@ class HashRing:
         """JSON-able ring state. ``from_snapshot`` on ANY process rebuilds
         an identical assignment — members are sorted so the snapshot is
         canonical regardless of join order."""
-        return dict(
+        snap = dict(
             members=sorted(self._members),
             vnodes=self.vnodes,
             seed=self.seed,
             version=self.version,
         )
+        live_weights = {
+            m: w
+            for m, w in sorted(self._weights.items())
+            if m in self._members and w != 1
+        }
+        if live_weights:  # omit when uniform: old consumers stay compatible
+            snap["weights"] = live_weights
+        return snap
 
     @classmethod
     def from_snapshot(cls, snap: dict) -> "HashRing":
@@ -190,6 +217,7 @@ class HashRing:
             vnodes=int(snap.get("vnodes", 64)),
             seed=int(snap.get("seed", 0)),
             version=int(snap.get("version", 0)),
+            weights=snap.get("weights") or None,
         )
 
 

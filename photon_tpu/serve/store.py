@@ -39,6 +39,7 @@ serve/engine.py. The store itself is single-writer: the engine serializes
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import logging
 from typing import Dict, List, Optional, Sequence
@@ -845,6 +846,169 @@ class HotColdEntityStore:
             compact_host=part.compact_host,
             re_types=types,
         )
+
+    # -- warm shard handoff ------------------------------------------------
+
+    def shard_export(
+        self,
+        target_snapshot: dict,
+        target_member: Optional[str] = None,
+        include_cold: bool = True,
+    ) -> dict:
+        """Everything a new owner needs BEFORE the ring flips: for each
+        sharded dense group, the entities this replica serves today whose
+        owner changes under ``target_snapshot`` (optionally only those
+        moving to ``target_member``), their host coefficient rows (raw
+        float32 bytes, base64 — exact, so handed-off rows score
+        bit-identically), and a hot flag for rows currently resident in
+        this replica's device cache. ``include_cold=False`` trims the
+        payload to the hot set — the join case, where the newcomer built
+        its own host shard from disk and only needs cache warmth.
+        Callers serialize with resolve (the engine's batch lock)."""
+        part = self._partition
+        out = dict(
+            fromReplica=part.replica_id if part is not None else None,
+            targetVersion=int(target_snapshot.get("version", 0)),
+            groups=[],
+        )
+        if part is None:
+            return out
+        target = HashRing.from_snapshot(target_snapshot)
+        for re_type, group in self._groups.items():
+            if group.pinned or not part.applies_to(re_type):
+                continue
+            eidx = self._entity_indexes.get(re_type)
+            keys: List[object] = []
+            hot: List[bool] = []
+            dense: List[int] = []
+            for i in range(group.num_entities):
+                if group.owned is not None and not group.owned[i]:
+                    continue
+                if group.compact_of is not None and group.compact_of[i] < 0:
+                    continue  # no host row here — nothing to hand off
+                key = eidx.entity_id(i) if eidx is not None else i
+                new_owner = target.owner(key)
+                if new_owner == part.replica_id:
+                    continue
+                if target_member is not None and new_owner != target_member:
+                    continue
+                is_hot = group.slot_peek(i) is not None
+                if not include_cold and not is_hot:
+                    continue
+                keys.append(key)
+                hot.append(bool(is_hot))
+                dense.append(i)
+            if not keys:
+                continue
+            idx = np.asarray(dense, np.int64)
+            src = (
+                group.compact_of[idx].astype(np.int64)
+                if group.compact_of is not None
+                else idx
+            )
+            coords = {}
+            for cid in group.coord_ids:
+                rows = np.ascontiguousarray(
+                    group.host_coefs[cid][src], dtype=np.float32
+                )
+                coords[cid] = dict(
+                    dim=int(rows.shape[1]),
+                    rows=base64.b64encode(rows.tobytes()).decode("ascii"),
+                )
+            out["groups"].append(
+                dict(reType=re_type, keys=keys, hot=hot, coords=coords)
+            )
+        return out
+
+    def shard_import(self, payload: dict, upload_chunk: int = 64) -> dict:
+        """Install a peer's :meth:`shard_export` payload: append host rows
+        this (compacted) master lacks — killing the FE-only window that
+        otherwise follows a drain, since ``set_partition`` never re-fetches
+        rows — and pre-promote the peer's hot set into the device cache so
+        the first post-flip requests hit instead of miss. ``upload_chunk``
+        must not exceed the warmed max batch size (the scatter buckets are
+        already compiled; a bigger chunk would retrace). Callers serialize
+        with resolve (the engine's batch lock)."""
+        stats = dict(rowsAdded=0, rowsKnown=0, unknownKeys=0, promoted=0)
+        reg = registry()
+        for rec in payload.get("groups") or []:
+            re_type = rec.get("reType")
+            group = self._groups.get(re_type)
+            if group is None or group.pinned:
+                continue
+            keys = rec.get("keys") or []
+            hot_flags = list(rec.get("hot") or [False] * len(keys))
+            ids = np.fromiter(
+                (self._intern(re_type, k, group.num_entities) for k in keys),
+                dtype=np.int64,
+                count=len(keys),
+            )
+            known = ids >= 0
+            stats["unknownKeys"] += int((~known).sum())
+            decoded: Optional[Dict[str, np.ndarray]] = {}
+            for cid in group.coord_ids:
+                c = (rec.get("coords") or {}).get(cid)
+                if c is None:
+                    decoded = None
+                    break
+                arr = np.frombuffer(
+                    base64.b64decode(c["rows"]), np.float32
+                ).reshape(-1, int(c["dim"]))
+                if arr.shape[0] != len(keys):
+                    decoded = None
+                    break
+                decoded[cid] = arr
+            if decoded is None:
+                continue
+            kn = np.flatnonzero(known)
+            if group.compact_of is not None and kn.size:
+                missing = kn[group.compact_of[ids[kn]] < 0]
+                if missing.size:
+                    base_rows = int(
+                        next(iter(group.host_coefs.values())).shape[0]
+                        if group.host_coefs
+                        else 0
+                    )
+                    for cid in group.coord_ids:
+                        group.host_coefs[cid] = np.ascontiguousarray(
+                            np.vstack(
+                                [group.host_coefs[cid], decoded[cid][missing]]
+                            )
+                        )
+                    group.compact_of[ids[missing]] = base_rows + np.arange(
+                        missing.size, dtype=np.int32
+                    )
+                    stats["rowsAdded"] += int(missing.size)
+                    reg.counter(
+                        "serve_store_handoff_rows_total", re_type=re_type
+                    ).inc(int(missing.size))
+                stats["rowsKnown"] += int(kn.size - missing.size)
+            else:
+                stats["rowsKnown"] += int(kn.size)
+            promote = [
+                int(e)
+                for e, h in zip(ids, hot_flags)
+                if h and e >= 0 and group.slot_peek(int(e)) is None
+            ]
+            if group.compact_of is not None:
+                promote = [e for e in promote if group.compact_of[e] >= 0]
+            promote = promote[: group.capacity]
+            chunk_n = max(1, int(upload_chunk))
+            promoted_here = 0
+            for start in range(0, len(promote), chunk_n):
+                chunk = promote[start:start + chunk_n]
+                for e in chunk:
+                    group.slot_claim(e, ())
+                _oom_contained(
+                    re_type, lambda c=list(chunk): self._upload(group, c)
+                )
+                promoted_here += len(chunk)
+            if promoted_here:
+                stats["promoted"] += promoted_here
+                reg.counter(
+                    "serve_store_handoff_promoted_total", re_type=re_type
+                ).inc(promoted_here)
+        return stats
 
     def _claim_slot(self, group: _ReGroup, entity: int, in_use: set) -> int:
         # Demotes the least-recently-used entity that is NOT part of the

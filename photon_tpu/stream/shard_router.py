@@ -193,6 +193,40 @@ def read_owned_segment(
     return owned, total
 
 
+def updater_spill_dir(spill_root: str, shard_index: int) -> str:
+    """Shard ``k``'s host-owned spill partition — ``<spill_root>/host-k/``
+    (re_store.partition_spill_dir over the ``updater:k`` member). An
+    updater shard parks its out-of-core host masters here so a shard-count
+    rebalance relocates them by file rename, not row re-stream."""
+    from photon_tpu.algorithm.re_store import partition_spill_dir
+
+    return partition_spill_dir(spill_root, f"{MEMBER_PREFIX}{shard_index}")
+
+
+def rebalance_updater_spill(
+    spill_root: str,
+    old_num_shards: int,
+    new_num_shards: int,
+    vnodes: int = 64,
+    seed: int = 0,
+) -> Dict[str, Dict]:
+    """Re-home spill partitions across a shard-count change: every
+    ``updater:k`` partition departed by the resize is adopted by its
+    deterministic successor on the new ring via ``os.replace`` (see
+    re_store.rebalance_spill_layout — and its locality-hint caveat: the
+    owned-record filter, not file placement, remains the correctness
+    boundary). Shrinking from 4 to 2 shards moves ``host-2``/``host-3``
+    files under the survivors; growing moves nothing (new shards start
+    cold) — either way, zero rows are decoded."""
+    from photon_tpu.algorithm.re_store import rebalance_spill_layout
+
+    return rebalance_spill_layout(
+        spill_root,
+        shard_ring(old_num_shards, vnodes=vnodes, seed=seed),
+        shard_ring(new_num_shards, vnodes=vnodes, seed=seed),
+    )
+
+
 def shard_spool_dir(out_root: str, shard_index: int) -> str:
     """Per-shard sub-spool directory the materializing router writes —
     ``out_root/shard-k/``. Shard worker k points its ``spool_dir`` here

@@ -140,6 +140,12 @@ class StreamingUpdaterConfig:
     norm_drift_bound: float = 10.0
     num_iterations: int = 1
     re_convergence_tol: float = 1e-4
+    # Out-of-core residency for the per-cycle fits (train/incremental.py
+    # pass-through). Sharded workers spill under the host-owned layout
+    # ``<re_spill_dir>/host-<shard_index>/`` so a shard-count rebalance is
+    # a file move (shard_router.rebalance_updater_spill), not a re-stream.
+    re_device_budget_mb: Optional[float] = None
+    re_spill_dir: Optional[str] = None
     # Sharded freshness plane: this worker is shard ``shard_index`` of
     # ``num_shards``. Records route by hashing the SAME per-entity string
     # serving's ``_owned_mask`` hashes (stream/shard_router.py), so each
@@ -417,10 +423,46 @@ class StreamingUpdater:
             if not self._cursor_matches(stream):
                 continue
             replay = stream.get("lateReplay") or {}
+            # Shard-granular replay cursor: the block carries its OWN shard
+            # tag (independent of the outer block's), so a sibling shard's
+            # replay chain is never adopted even if a future manifest merge
+            # drops the outer tag — each shard's crash-resume point is its
+            # own last replay, full stop. Untagged blocks (pre-shard plane)
+            # still count for every shard, same 1→N adoption rule as
+            # segment cursors.
+            tag = replay.get("shard")
+            if tag and not (
+                int(tag.get("of", 0)) == self.config.num_shards
+                and int(tag.get("index", -1)) == self.config.shard_index
+            ):
+                continue
             pairs = replay.get("pairs")
             if pairs is not None:
                 return {str(k): int(v) for k, v in pairs.items()}
         return {}
+
+    def _re_spill_kwargs(self) -> Dict:
+        """Out-of-core residency pass-through for the per-cycle fits.
+
+        Sharded workers resolve their spill root through the host-owned
+        layout (``host-<shard_index>/``) so a shard-count rebalance moves
+        files (shard_router.rebalance_updater_spill) instead of
+        re-streaming rows; the single-updater plane spills flat.
+        """
+        cfg = self.config
+        out: Dict = {}
+        if cfg.re_device_budget_mb is not None:
+            out["re_device_budget_mb"] = cfg.re_device_budget_mb
+        if cfg.re_spill_dir is not None:
+            if cfg.num_shards > 1:
+                from photon_tpu.stream.shard_router import updater_spill_dir
+
+                out["re_spill_dir"] = updater_spill_dir(
+                    cfg.re_spill_dir, cfg.shard_index
+                )
+            else:
+                out["re_spill_dir"] = cfg.re_spill_dir
+        return out
 
     def consumed_through(self) -> int:
         """Highest spool segment sequence already folded into the published
@@ -659,6 +701,7 @@ class StreamingUpdater:
             emit_delta=emit_delta,
             extra_manifest={"stream": stream_info},
             serialize_publish=bool(serialize),
+            **self._re_spill_kwargs(),
         )
         self._train_s += time.monotonic() - t_train
         self._records_trained += len(records)
@@ -815,9 +858,18 @@ class StreamingUpdater:
         )
         cursors = self.consumed_per_spool()
         multi = len(dirs) > 1 or is_spool_glob(cfg.spool_dir)
+        late_block: Dict = {"pairs": new_pairs, "records": len(fresh)}
+        if cfg.num_shards > 1:
+            # Shard-granular cursor tag (see _replayed_pairs): the replay
+            # block names its owner so sibling shards' cursor walks skip it
+            # no matter how the outer block is interpreted.
+            late_block["shard"] = {
+                "index": cfg.shard_index,
+                "of": cfg.num_shards,
+            }
         stream_info: Dict = {
             _CURSOR_KEY: max(cursors.values(), default=0),
-            "lateReplay": {"pairs": new_pairs, "records": len(fresh)},
+            "lateReplay": late_block,
         }
         if multi:
             stream_info[_PER_SPOOL_KEY] = cursors
@@ -845,6 +897,7 @@ class StreamingUpdater:
             emit_delta=bool(cfg.delta_artifacts),
             extra_manifest={"stream": stream_info},
             serialize_publish=bool(serialize),
+            **self._re_spill_kwargs(),
         )
         self._train_s += time.monotonic() - t_train
         if result.published:
@@ -1020,6 +1073,7 @@ class StreamingUpdater:
             emit_delta=False,
             extra_manifest={"stream": stream_info},
             serialize_publish=bool(serialize),
+            **self._re_spill_kwargs(),
         )
         self._train_s += time.monotonic() - t_train
         if result.published:

@@ -219,6 +219,7 @@ def incremental_update(
     re_convergence_tol: float = 1e-4,
     re_device_budget_mb: Optional[float] = None,
     re_spill_dir: Optional[str] = None,
+    re_spill_member: Optional[str] = None,
     dead_letters: Optional[List[dict]] = None,
     publish: bool = True,
     emit_delta: bool = False,
@@ -301,6 +302,7 @@ def incremental_update(
         re_convergence_tol=re_convergence_tol,
         re_device_budget_mb=re_device_budget_mb,
         re_spill_dir=re_spill_dir,
+        re_spill_member=re_spill_member,
     )
     results = estimator.fit(
         batch,

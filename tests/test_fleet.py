@@ -573,3 +573,472 @@ def test_fleet_three_replicas_parity_kill_revive(tmp_path):
         assert {"r0", "r1", "r2"} <= set(os.listdir(str(tmp_path / "spool")))
     finally:
         fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Weighted ring, TCP transport, warm handoff, split-brain, host-owned spill
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_ring_proportional_ownership_and_movement_bounds():
+    """Heterogeneous member weights: a weight-w member owns ~w shares of
+    the hash space, snapshots round-trip bit-identically, and a weighted
+    join still moves only (about) the joiner's share of keys — the
+    consistent-hashing contract generalized to weighted vnode counts."""
+    ring = HashRing(["A", "B", "C"], vnodes=64, weights={"C": 3})
+    owned = {m: 0 for m in ("A", "B", "C")}
+    for k in KEYS:
+        owned[ring.owner(k)] += 1
+    # 5 total shares: A=1/5, B=1/5, C=3/5 (loose tolerance — vnode noise).
+    assert abs(owned["C"] / len(KEYS) - 0.6) < 0.12
+    assert abs(owned["A"] / len(KEYS) - 0.2) < 0.1
+    assert abs(owned["B"] / len(KEYS) - 0.2) < 0.1
+    # Fractions from shard_ranges agree with measured ownership.
+    fr = ring.shard_ranges()
+    assert abs(fr["C"]["fraction"] - 0.6) < 0.12
+    # Snapshot round-trip preserves every assignment (weights included).
+    rebuilt = HashRing.from_snapshot(ring.snapshot())
+    assert not moved_keys(ring, rebuilt, KEYS)
+    assert rebuilt.member_vnodes("C") == 3 * 64
+    # A weight-2 joiner takes ~2/7 of the keys and ONLY those keys move.
+    after = HashRing.from_snapshot(ring.snapshot())
+    after.add("D", weight=2)
+    moved = moved_keys(ring, after, KEYS)
+    share = 2.0 / 7.0
+    assert len(moved) / len(KEYS) <= share + 0.08
+    assert all(after.owner(k) == "D" for k in moved)
+    # Uniform-weight snapshots stay in the legacy shape (no weights key).
+    assert "weights" not in HashRing(["A", "B"], vnodes=64).snapshot()
+
+
+def test_frame_roundtrip_byte_identical_over_unix_and_tcp():
+    """The PR 7 frame protocol carries the SAME bytes over AF_UNIX and
+    TCP — the transport changes the pipe, never the encoding — and both
+    decode back to the original message."""
+    import socket as socket_mod
+    import threading
+
+    from photon_tpu.serve.frontend import _recv_frame, _send_frame
+
+    msg = {
+        "id": 7, "op": "score",
+        "request": {"features": {"a": [1.5, -2.25]},
+                    "entityIds": {"userId": "user3"}},
+        "tenant": "tenantA",
+    }
+
+    def capture(make_pair):
+        a, b = make_pair()
+        try:
+            _send_frame(a, msg, threading.Lock())
+            raw = b.recv(1 << 20)
+            a2, b2 = make_pair()
+            try:
+                _send_frame(a2, msg, threading.Lock())
+                decoded = _recv_frame(b2)
+            finally:
+                a2.close(); b2.close()
+            return raw, decoded
+        finally:
+            a.close(); b.close()
+
+    def unix_pair():
+        return socket_mod.socketpair(socket_mod.AF_UNIX)
+
+    def tcp_pair():
+        srv = socket_mod.create_server(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        a = socket_mod.create_connection(("127.0.0.1", port))
+        b, _ = srv.accept()
+        srv.close()
+        return a, b
+
+    unix_raw, unix_msg = capture(unix_pair)
+    tcp_raw, tcp_msg = capture(tcp_pair)
+    assert unix_raw == tcp_raw  # byte-identical wire format
+    assert unix_msg == tcp_msg == msg
+    # And the frame really is length-prefixed big-endian + UTF-8 JSON.
+    import struct
+    (n,) = struct.unpack(">I", unix_raw[:4])
+    assert n == len(unix_raw) - 4
+    assert json.loads(unix_raw[4:].decode()) == msg
+
+
+def test_tcp_transport_requires_and_verifies_shared_secret():
+    """TCP endpoints refuse to listen unauthenticated, reject a wrong
+    shared secret with PermissionError (never retried), and serve a
+    correct one — the HMAC handshake in both directions."""
+    from photon_tpu.serve.frontend import ScorerClient, ScorerServer
+
+    with pytest.raises(ValueError):
+        ScorerServer(None, "tcp://127.0.0.1:0")  # no secret, no listen
+    srv = ScorerServer(None, "tcp://127.0.0.1:0", secret="s3cr3t")
+    srv.start()
+    try:
+        assert srv.socket_path.startswith("tcp://127.0.0.1:")
+        fails0 = registry().counter("fleet_auth_failures_total").value
+        client = ScorerClient(
+            srv.socket_path, connect_timeout_s=10, secret="s3cr3t"
+        )
+        try:
+            assert client.call("ping", timeout_s=10) == "pong"
+        finally:
+            client.close()
+        t0 = time.monotonic()
+        with pytest.raises(PermissionError):
+            ScorerClient(
+                srv.socket_path, connect_timeout_s=30, secret="wrong"
+            )
+        # A bad secret fails FAST (no connect-retry loop) and is counted.
+        assert time.monotonic() - t0 < 5.0
+        assert registry().counter(
+            "fleet_auth_failures_total").value == fails0 + 1
+    finally:
+        srv.close()
+
+
+def test_warm_handoff_kills_fe_only_window_bit_exact(tmp_path):
+    """The leave-side warm handoff at store level: the departing owner
+    exports its host rows against the future ring, the survivor imports
+    them (appending to its compacted master + pre-promoting the hot set),
+    and after the ring flips EVERY inherited key scores from bit-identical
+    coefficients — no FE-only window, no re-stream from disk."""
+    from test_serving import make_entity_index as _mk_eidx
+
+    ring = HashRing(["A", "B"], vnodes=64, seed=0)
+    model = make_model()
+    w_re = np.asarray(model.models["per_user"].coefficients)
+
+    def mk(member):
+        return HotColdEntityStore(
+            model, {"userId": _mk_eidx()}, hot_bytes=1, min_hot_rows=8,
+            partition=StorePartition(member, ring, re_types=("userId",)),
+        )
+
+    store_a, store_b = mk("A"), mk("B")
+    b_owned = _owned_users(ring, "B")
+    assert len(b_owned) > 8
+    store_b.resolve("userId", [f"user{e}" for e in b_owned[:5]])  # warm 5
+    after = HashRing.from_snapshot(ring.snapshot())
+    after.remove("B")
+
+    payload = store_b.shard_export(
+        after.snapshot(), target_member="A", include_cold=True
+    )
+    assert len(payload["groups"]) == 1
+    grp = payload["groups"][0]
+    assert len(grp["keys"]) == len(b_owned) and sum(grp["hot"]) == 5
+    stats = store_a.shard_import(payload, upload_chunk=8)
+    # Survivor's compacted master lacked every inherited row; the hot 5
+    # are pre-promoted into the device cache before the flip.
+    assert stats["rowsAdded"] == len(b_owned) and stats["promoted"] == 5
+    assert stats["unknownKeys"] == 0
+
+    store_a.set_partition(StorePartition("A", after, re_types=("userId",)))
+    for start in range(0, len(b_owned), 6):
+        chunk = b_owned[start:start + 6]
+        slots = store_a.resolve("userId", [f"user{e}" for e in chunk])
+        assert all(s >= 0 for s in slots)  # the FE-only window is gone
+        table = np.asarray(
+            store_a.scoring_model().models["per_user"].coefficients
+        )
+        for e, s in zip(chunk, slots):
+            np.testing.assert_array_equal(table[s], w_re[e])
+    # Idempotent: a re-delivered payload adds nothing new.
+    again = store_a.shard_import(payload, upload_chunk=8)
+    assert again["rowsAdded"] == 0
+    assert again["rowsKnown"] == len(b_owned)
+
+
+def test_join_handoff_exports_hot_set_only():
+    """The join-side handoff trims to the hot set (include_cold=False):
+    the newcomer builds its own host shard from disk, so incumbents ship
+    cache WARMTH, not rows."""
+    from test_serving import make_entity_index as _mk_eidx
+
+    ring = _ring2()
+    store_b = HotColdEntityStore(
+        make_model(), {"userId": _mk_eidx()}, hot_bytes=1, min_hot_rows=8,
+        partition=StorePartition("B", ring, re_types=("userId",)),
+    )
+    b_owned = _owned_users(ring, "B")
+    future = HashRing.from_snapshot(ring.snapshot())
+    future.add("C")
+    movers = [e for e in b_owned if future.owner(f"user{e}") == "C"]
+    stayers = [e for e in b_owned if future.owner(f"user{e}") == "B"]
+    assert movers and stayers
+    # Warm a mix of entities that move to C and entities that stay on B.
+    warm = movers[:3] + stayers[:3]
+    store_b.resolve("userId", [f"user{e}" for e in warm])
+    payload = store_b.shard_export(
+        future.snapshot(), target_member="C", include_cold=False
+    )
+    got = payload["groups"][0]["keys"]
+    # Only the HOT entities actually moving to C ship; warm stayers and
+    # cold movers do not.
+    assert sorted(got) == sorted(f"user{e}" for e in movers[:3])
+    assert all(payload["groups"][0]["hot"])
+
+
+def test_split_brain_push_rejected_and_counted(tmp_path):
+    """Two routers fighting over one replica: the second router's stale
+    ring epoch is REJECTED (splitBrain=True), counted, and the replica
+    stays on the first claimant's ring. A newer epoch from the second
+    router is accepted — claims transfer forward, never backward."""
+    from photon_tpu.serve import ServeConfig, ServingEngine
+    from photon_tpu.serve.fleet import ReplicaScorerServer
+    from photon_tpu.serve.frontend import ScorerClient
+
+    ring = _ring2()
+    engine = ServingEngine(
+        make_model(), entity_indexes={"userId": make_entity_index()},
+        config=ServeConfig(max_batch_size=8, max_delay_ms=1.0, hot_bytes=1),
+    )
+    sock = str(tmp_path / "replica.sock")
+    server = ReplicaScorerServer(engine, sock, "A", route_re_type="userId")
+    server.start()
+    try:
+        c1 = ScorerClient(sock, connect_timeout_s=10)
+        c2 = ScorerClient(sock, connect_timeout_s=10)
+        try:
+            splits0 = registry().counter("fleet_split_brain_total").value
+            snap = ring.snapshot()
+            r1 = c1.call("ring", timeout_s=30, snapshot=snap,
+                         routerId="router-1")
+            assert r1["splitBrain"] is False
+            # Same epoch, different router: split brain — rejected.
+            r2 = c2.call("ring", timeout_s=30, snapshot=snap,
+                         routerId="router-2")
+            assert r2["splitBrain"] and r2["rejected"]
+            assert r2["claimant"] == "router-1"
+            assert registry().counter(
+                "fleet_split_brain_total").value == splits0 + 1
+            info = c1.call("replica_info", timeout_s=30)
+            assert info["ringClaimant"] == "router-1"
+            assert info["ringVersion"] == snap["version"]
+            # Router-2 pushes a NEWER epoch: legitimate takeover.
+            newer = HashRing.from_snapshot(snap)
+            newer.add("C")
+            r3 = c2.call("ring", timeout_s=30, snapshot=newer.snapshot(),
+                         routerId="router-2")
+            assert r3["splitBrain"] is False
+            assert c1.call(
+                "replica_info", timeout_s=30)["ringClaimant"] == "router-2"
+        finally:
+            c1.close()
+            c2.close()
+    finally:
+        server.close()
+        engine.close()
+
+
+def test_split_brain_burns_the_router_slo(tmp_path):
+    """The router side of the guard: a rejected ring push records a bad
+    event on the ``fleet_split_brain`` objective and the drill windows
+    page within seconds — detection → page, not detection → log line."""
+    from photon_tpu.serve.admission import FleetAdmissionLedger
+    from photon_tpu.serve.fleet import FleetRouter
+
+    ring = _ring2()
+    router = FleetRouter(ring, FleetAdmissionLedger(), "userId",
+                         router_id="router-x")
+    for _ in range(3):
+        router.slo.record_event("fleet_split_brain", good=False)
+    snap = router.fleet_snapshot()
+    assert snap["routerId"] == "router-x"
+    obj = snap["slo"]["objectives"]["fleet_split_brain"]
+    assert obj["state"] == "page"
+
+
+def test_spill_partition_rebalance_is_file_move(tmp_path):
+    """Host-owned spill layout: shard k's files live under ``host-k/``;
+    shrinking the ring re-homes departed partitions by ``os.replace`` —
+    the SAME inodes appear under the survivors (a rename, provably not a
+    data copy) and growing the ring moves nothing."""
+    from photon_tpu.algorithm.re_store import (
+        partition_spill_dir,
+        rebalance_spill_layout,
+    )
+    from photon_tpu.serve.routing import HashRing as _HR
+    from photon_tpu.stream.shard_router import (
+        rebalance_updater_spill,
+        shard_ring,
+        updater_spill_dir,
+    )
+
+    root = str(tmp_path / "spill")
+    inodes = {}
+    for k in range(4):
+        d = updater_spill_dir(root, k)
+        assert d == os.path.join(root, f"host-{k}")
+        path = os.path.join(d, f"block00000_features_{k}.npy")
+        np.save(path, np.full((3, 2), float(k), np.float32))
+        inodes[k] = os.stat(path).st_ino
+    moves = rebalance_updater_spill(root, 4, 2)
+    ring2 = shard_ring(2)
+    # Every departed partition was adopted by its deterministic successor.
+    assert set(moves) == {"updater:2", "updater:3"}
+    for k in (2, 3):
+        rec = moves[f"updater:{k}"]
+        assert rec["moved"] == 1
+        assert rec["successor"] == ring2.owner(f"updater:{k}")
+        succ_dir = os.path.join(
+            root, f"host-{rec['successor'].rsplit(':', 1)[1]}"
+        )
+        moved_path = os.path.join(
+            succ_dir, f"block00000_features_{k}.npy"
+        )
+        assert os.path.exists(moved_path)
+        # Same inode: a rename, not a copy — and bytes intact.
+        assert os.stat(moved_path).st_ino == inodes[k]
+        np.testing.assert_array_equal(
+            np.load(moved_path), np.full((3, 2), float(k), np.float32)
+        )
+        assert not os.path.isdir(os.path.join(root, f"host-{k}"))
+    # Survivors kept their own files in place.
+    for k in (0, 1):
+        p = os.path.join(root, f"host-{k}", f"block00000_features_{k}.npy")
+        assert os.stat(p).st_ino == inodes[k]
+    # Growing adds members but moves no files (new shards start cold).
+    assert rebalance_updater_spill(root, 2, 4) == {}
+    # Name collisions keep both copies via the from-<k>__ prefix.
+    d0 = partition_spill_dir(str(tmp_path / "c"), 0)
+    d1 = partition_spill_dir(str(tmp_path / "c"), 1)
+    np.save(os.path.join(d0, "x.npy"), np.zeros(1))
+    np.save(os.path.join(d1, "x.npy"), np.ones(1))
+    out = rebalance_spill_layout(
+        str(tmp_path / "c"), _HR(["0", "1"]), _HR(["0"])
+    )
+    assert out["1"]["moved"] == 1
+    assert os.path.exists(os.path.join(d0, "from-1__x.npy"))
+
+
+def test_fleet_tcp_transport_parity_warm_join_and_leave(tmp_path):
+    """Tentpole end to end over TCP loopback: scores are bit-identical to
+    the batch driver (and therefore to the Unix-socket fleet), a warm
+    join hands the newcomer its hot set before the ring flips, and a warm
+    leave ships the departing shard's rows to the survivors so post-drain
+    scoring stays EXACT — the FE-only degradation window is gone. Zero
+    caller errors throughout; per-peer RPC metrics flow."""
+    from test_serving import _publish_generation
+
+    from photon_tpu.serve.fleet import FleetBackend, ScorerFleet
+
+    root = str(tmp_path / "pub")
+    os.makedirs(root)
+    model = _publish_generation(root, "gen-1", 1.0)
+    fleet = ScorerFleet(
+        os.path.join(root, "gen-1"), str(tmp_path / "work"),
+        artifacts_dir=root, route_re_type="userId",
+        hot_bytes=1, max_batch_size=8, max_delay_ms=1.0,
+        spool_base=str(tmp_path / "spool"),
+        transport="tcp",
+    )
+    try:
+        fleet.start(["r0", "r1"])
+        assert all(
+            fleet.socket_path(r).startswith("tcp://") for r in ("r0", "r1")
+        )
+        backend = FleetBackend(fleet.router)
+        rng = np.random.default_rng(11)
+        n = 24
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        users = np.arange(n) % N_ENTITIES
+        ref = batch_scores(model, xa, xb, users)
+
+        def score_all():
+            futs = [
+                backend.submit(
+                    _score_request(xa[i], xb[i], users[i]),
+                    "tenantA", "interactive",
+                )
+                for i in range(n)
+            ]
+            out, errors, used = np.zeros(n, np.float32), 0, set()
+            for i, f in enumerate(futs):
+                try:
+                    res = f.result(60)
+                    out[i] = res["score"]
+                    used.add(res["replica"])
+                except Exception:  # noqa: BLE001 — counted, asserted zero
+                    errors += 1
+            return out, errors, used
+
+        got, errors, used = score_all()
+        assert errors == 0 and used == {"r0", "r1"}
+        np.testing.assert_array_equal(got, ref)  # TCP ≡ batch ≡ unix
+
+        # Warm elastic join: the newcomer serves immediately and the
+        # fleet still scores bit-exact.
+        fleet.join("r2", warm=True)
+        got2, errors2, used2 = score_all()
+        assert errors2 == 0 and "r2" in used2
+        np.testing.assert_array_equal(got2, ref)
+
+        # Warm drain: survivors inherited r2's rows BEFORE the flip, so
+        # scoring stays exact — no FE-only window to wait out.
+        fleet.leave("r2", warm=True, settle_s=10.0)
+        got3, errors3, used3 = score_all()
+        assert errors3 == 0 and "r2" not in used3
+        np.testing.assert_array_equal(got3, ref)
+
+        # Per-peer RPC telemetry exists for the score path.
+        lat = registry().find(
+            "fleet_rpc_latency_s", replica="r0", op="score"
+        )
+        assert lat is not None and lat.count > 0
+        snap = fleet.fleet_snapshot()
+        assert snap["routerId"].startswith("router-")
+        assert "fleet_split_brain" in snap["slo"]["objectives"]
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_ledger_surfaces_per_tenant_quality():
+    """Satellite: per-tenant ``quality_auc``/``auc_lift`` ride the fleet
+    admission ledger into the ``/healthz`` tenants block — count-weighted
+    across replicas and versions, baseline lane excluded."""
+    from photon_tpu.obs.quality import QualityConfig, QualityPlane
+    from photon_tpu.serve.admission import (
+        FleetAdmissionLedger,
+        tenant_quality,
+    )
+
+    plane = QualityPlane(QualityConfig(min_events=1))
+    plane.set_baseline("gen-base")
+    rng = np.random.default_rng(3)
+    for tenant in ("tenantA", "tenantB"):
+        for i in range(40):
+            label = float(i % 2)
+            # tenantA's scores separate the classes; tenantB's are noise.
+            score = (
+                (label * 2.0 - 1.0) * 2.0 if tenant == "tenantA"
+                else float(rng.normal())
+            )
+            plane.observe(score, label, model_version="gen-1",
+                          tenant=tenant, re_type="userId")
+            plane.observe(float(rng.normal()), label,
+                          model_version="gen-base", tenant=tenant,
+                          re_type="userId")
+    snap = plane.snapshot()
+    per_tenant = tenant_quality([snap])
+    assert set(per_tenant) == {"tenantA", "tenantB"}
+    assert per_tenant["tenantA"]["quality_auc"] == 1.0
+    assert per_tenant["tenantA"]["observations"] == 40
+    # Lift vs the measured baseline lane is present and positive for the
+    # separating model; the baseline lane itself contributed no tenant row.
+    assert per_tenant["tenantA"]["auc_lift"] > 0.2
+
+    ledger = FleetAdmissionLedger()
+    ledger.admit("tenantA")
+    ledger.update_quality(per_tenant)
+    tenants = ledger.fleet_snapshot()["tenants"]
+    assert tenants["tenantA"]["admitted"] == 1
+    assert tenants["tenantA"]["quality_auc"] == 1.0
+    assert tenants["tenantA"]["auc_lift"] > 0.2
+    # Quality-only tenants still appear (zeroed admission counters).
+    assert tenants["tenantB"]["admitted"] == 0
+    assert "quality_auc" in tenants["tenantB"]
+    # A replica that errored its stats scrape contributes nothing.
+    assert tenant_quality([None, {"error": "boom"}]) == {}

@@ -1527,3 +1527,69 @@ def test_updater_fe_retrain_actuates(tmp_path):
     assert res2 is not None and res2.published
     assert registry().counter("stream_fe_retrains_total").value == retrains0 + 1
     assert upd.stats()["fe_retrains"] == 1
+
+
+def test_late_replay_cursor_is_shard_granular_and_crash_independent(tmp_path):
+    """Shard-granular replay cursors: each shard's ``stream.lateReplay``
+    block carries its OWN shard tag, siblings never adopt each other's
+    pair cursor, and a shard that crashed before ITS replay still sees
+    every unconsumed pair afterwards — one shard's progress is never
+    another shard's data loss."""
+    from photon_tpu.io.model_io import load_generation_manifest
+    from photon_tpu.stream.shard_router import shard_of_record, shard_ring
+    from photon_tpu.stream.updater import spool_dir_key
+
+    root, sdir = str(tmp_path / "pub"), str(tmp_path / "spool")
+    os.makedirs(root)
+    _, imaps, eidx = _updater_root(root)
+    ring = shard_ring(2)
+    # Entities landing on each shard, derived from the live routing rule.
+    by_shard = {0: [], 1: []}
+    for e in range(N_ENTITIES):
+        rec = {"entityIds": {"userId": f"user{e}"}}
+        by_shard[shard_of_record(rec, ring)].append(e)
+    assert by_shard[0] and by_shard[1]
+    _append_sidecar(sdir, _late_pair_lines(4, by_shard[0][:2], seed=71))
+    _append_sidecar(sdir, _late_pair_lines(4, by_shard[1][:2], seed=72))
+    key = spool_dir_key(sdir)
+
+    def shard(k):
+        return _updater(root, sdir, imaps, eidx,
+                        norm_drift_bound=1e12,
+                        late_replay_cadence_s=0.01, late_replay_min_pairs=2,
+                        num_shards=2, shard_index=k)
+
+    # Shard 0 replays its 4 owned pairs and publishes a tagged cursor.
+    upd0 = shard(0)
+    res0 = upd0.replay_late_labels()
+    assert res0 is not None and res0.published and res0.records == 4
+    man = load_generation_manifest(os.path.join(root, res0.generation))
+    late = man["stream"]["lateReplay"]
+    assert late["pairs"] == {key: 8}  # cursor counts ALL sidecar pairs
+    assert late["shard"] == {"index": 0, "of": 2}  # ...but is shard-tagged
+
+    # Crash independence: shard 1 (restarting AFTER shard 0's publish)
+    # must not adopt shard 0's cursor — its own pairs are unconsumed.
+    upd1 = shard(1)
+    assert upd1._replayed_pairs() == {}
+    res1 = upd1.replay_late_labels()
+    assert res1 is not None and res1.published and res1.records == 4
+
+    # Both shards' cursor walks now resolve to their OWN chain.
+    assert shard(0)._replayed_pairs() == {key: 8}
+    assert shard(1)._replayed_pairs() == {key: 8}
+    # Re-runs replay nothing on either shard (cursor floor holds).
+    assert shard(0).replay_late_labels() is None
+    assert shard(1).replay_late_labels() is None
+
+    # Defense-in-depth: a lineage block whose OUTER shape matches this
+    # worker but whose lateReplay tag names a sibling is skipped — the
+    # inner tag, not block position, owns the cursor.
+    upd = shard(0)
+    foreign = {
+        "consumedThrough": 0,
+        "lateReplay": {"pairs": {key: 99},
+                       "shard": {"index": 1, "of": 2}},
+    }
+    upd._stream_blocks = lambda: iter([foreign])
+    assert upd._replayed_pairs() == {}
