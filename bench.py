@@ -3828,6 +3828,281 @@ def run_freshness_lift(smoke: bool = False, E: int = 64, hot_entities: int = 8):
     }
 
 
+def run_staleness_frontier(smoke: bool = False, E: int = 64,
+                           hot_entities: int = 8) -> dict:
+    """Accuracy-vs-staleness frontier (--staleness-frontier): HOW FAST a
+    frozen model decays under drift, as a measured curve — the companion
+    number to --freshness-lift's single endpoint gap.
+
+    Reuses the lift harness world: per-entity true weights walk away from
+    gen-1's at a fixed rate while live traffic scores and labels. The
+    frozen gen-1 baseline lane re-scores every joined label, so its
+    WINDOWED online AUC at elapsed time t is exactly the accuracy of a
+    model t seconds stale; sampling it as the drift runs traces the
+    frontier. The streaming updater keeps the primary lane fresh the
+    whole time — its curve is the near-zero-staleness anchor the frozen
+    curve falls away from.
+
+    Asserts the frontier DECAYS (first-bucket frozen AUC − last-bucket ≥
+    the decay bar), that fresh serving holds the line where the frozen
+    model has decayed (end-of-run fresh − frozen ≥ the lift bar), with
+    zero caller errors and zero post-warmup retraces.
+    """
+    import os
+    import tempfile
+    import threading
+
+    from photon_tpu.cli.game_serving import RolloutOptions, _reload_watcher
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        load_game_model,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import GeneralizedLinearModel
+    from photon_tpu.obs.quality import (
+        QualityAccumulator,
+        QualityConfig,
+        QualityPlane,
+    )
+    from photon_tpu.serve import ScoreRequest, ServeConfig, ServingEngine
+    from photon_tpu.stream.spool import FeedbackSpool, SpoolConfig
+    from photon_tpu.stream.updater import (
+        StreamingUpdater,
+        StreamingUpdaterConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    d_fix, d_re = 5, 3
+    task = TaskType.LOGISTIC_REGRESSION
+    coord_configs = [
+        FixedEffectCoordinateConfig("global", "global"),
+        RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+    ]
+    if smoke:
+        window_s, num_windows = 3.0, 2
+        duration_s, sample_dt, buckets = 36.0, 1.5, 4
+        drift_rate, decay_bar, lift_bar = 0.4, 0.03, 0.02
+    else:
+        window_s, num_windows = 6.0, 2
+        duration_s, sample_dt, buckets = 90.0, 2.0, 5
+        drift_rate, decay_bar, lift_bar = 0.2, 0.05, 0.04
+    pool_min = 100
+
+    rng = np.random.default_rng(71)
+    w_fix = rng.normal(size=d_fix).astype(np.float32)
+    w_re = rng.normal(size=(E, d_re)).astype(np.float32)
+    drift_dir = np.random.default_rng(77).normal(
+        size=(hot_entities, d_re)
+    ).astype(np.float32)
+
+    root = tempfile.mkdtemp(prefix="staleness-frontier-")
+    sdir = os.path.join(root, "spool")
+    imaps = {
+        "global": IndexMap.build([f"g{j}" for j in range(d_fix)]),
+        "per_user": IndexMap.build([f"r{j}" for j in range(d_re)]),
+    }
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"user{e}")
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+    g1 = os.path.join(root, "gen-1")
+    save_game_model(
+        GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(Coefficients(w_fix), task), "global"
+            ),
+            "per_user": RandomEffectModel(w_re, "userId", "per_user", task),
+        }),
+        g1, imaps, {"userId": eidx}, sparsity_threshold=0.0,
+    )
+    write_generation_manifest(g1, parent=None)
+    assert gate_and_publish(root, "gen-1").ok
+
+    _progress("staleness frontier: serve + updater under drift")
+    engine = ServingEngine(
+        load_game_model(g1, imaps, {"userId": eidx}, to_device=False),
+        entity_indexes={"userId": eidx}, index_maps=imaps,
+        config=ServeConfig(max_batch_size=8, max_delay_ms=1.0,
+                           hot_bytes=1 << 30, max_versions=4,
+                           shadow_fraction=1.0, promotion_settle_s=300.0),
+        model_version=g1,
+    )
+    # Short windows: the windowed AUC at time t must reflect ONLY recent
+    # labels, or the curve smears staleness buckets together.
+    engine.quality = QualityPlane(QualityConfig(
+        task="logistic", window_s=window_s, num_windows=num_windows,
+        min_events=20, auc_drop_bound=0.05, ece_bound=0.9,
+    ))
+    spool = FeedbackSpool(sdir, SpoolConfig(segment_max_records=24,
+                                            segment_max_age_s=0.25))
+    spool.start_auto_flush()
+    engine.attach_feedback(spool)
+    engine.enable_quality_baseline("gen-1", fraction=1.0)
+
+    stop_w = threading.Event()
+    watcher = threading.Thread(
+        target=_reload_watcher,
+        args=(engine, root, 0.05, stop_w,
+              RolloutOptions(shadow_fraction=1.0, shadow_quota=8,
+                             divergence_bound=1e6, breaker_trip_bound=1000,
+                             max_reload_attempts=3, backoff_s=0.05)),
+        daemon=True,
+    )
+    watcher.start()
+    updater = StreamingUpdater(
+        StreamingUpdaterConfig(
+            publish_root=root, spool_dir=sdir, task=task,
+            coordinate_configs=coord_configs,
+            update_sequence=["global", "per_user"],
+            cadence_s=0.2, min_records=24, locked_coordinates=["global"],
+            delta_artifacts=True, num_iterations=1, norm_drift_bound=1e4,
+        ),
+        imaps, {"userId": eidx},
+    )
+    upd_thread = threading.Thread(target=updater.run_forever, daemon=True)
+    upd_thread.start()
+
+    Xf = np.random.default_rng(72).normal(size=(64, d_fix)).astype(np.float32)
+    Xr = np.random.default_rng(73).normal(size=(64, d_re)).astype(np.float32)
+    Xf[:, 0] = 1.0
+    Xr[:, 0] = 1.0
+    ok_n = errors = 0
+    lock = threading.Lock()
+    done = threading.Event()
+    t_drift0 = time.monotonic()
+
+    def true_label(i, u):
+        elapsed = time.monotonic() - t_drift0
+        w_true = w_re[u] + drift_rate * elapsed * drift_dir[u]
+        logit = float(Xf[i] @ w_fix + Xr[i] @ w_true)
+        return 1.0 if logit > 0 else 0.0
+
+    def producer(seed):
+        nonlocal ok_n, errors
+        r = np.random.default_rng(seed)
+        k = 0
+        while not done.is_set():
+            i = int(r.integers(0, 64))
+            u = int(r.integers(0, hot_entities))
+            uid = f"{seed}-{k}:{i}:{u}"
+            k += 1
+            try:
+                engine.submit(ScoreRequest(
+                    {"global": Xf[i], "per_user": Xr[i]},
+                    {"userId": f"user{u}"},
+                    uid=uid,
+                )).result(timeout=120)
+                engine.feedback_label(uid, true_label(i, u))
+                with lock:
+                    ok_n += 1
+            except Exception:  # noqa: BLE001 — any escape fails the bench
+                with lock:
+                    errors += 1
+            time.sleep(0.002)
+
+    producers = [threading.Thread(target=producer, args=(s,), daemon=True)
+                 for s in (211, 212)]
+    for t in producers:
+        t.start()
+
+    def pooled():
+        cfg = engine.quality.config
+        fresh = QualityAccumulator(cfg.score_bins, cfg.calibration_bins)
+        base = QualityAccumulator(cfg.score_bins, cfg.calibration_bins)
+        for key, acc in engine.quality.window_totals().items():
+            (base if key[0] == "gen-1" else fresh).merge(acc)
+        return fresh, base
+
+    samples = []
+    deadline = t_drift0 + duration_s
+    while time.monotonic() < deadline:
+        time.sleep(sample_dt)
+        fresh, base = pooled()
+        if fresh.count < pool_min or base.count < pool_min:
+            continue
+        fa, ba = fresh.auc(), base.auc()
+        if fa is None or ba is None:
+            continue
+        samples.append(dict(
+            staleness_s=round(time.monotonic() - t_drift0, 2),
+            frozen_auc=round(float(ba), 4),
+            fresh_auc=round(float(fa), 4),
+            frozen_events=base.count, fresh_events=fresh.count,
+        ))
+    done.set()
+    for t in producers:
+        t.join(timeout=10)
+    retraces = engine.retraces_since_warmup
+    promoted = os.path.basename(str(engine.model_version).rstrip("/"))
+    updater.stop()
+    upd_thread.join(timeout=120)
+    stop_w.set()
+    watcher.join(timeout=10)
+    engine.close()
+
+    assert errors == 0, f"{errors} caller-visible errors"
+    assert retraces == 0, f"{retraces} retraces after warm-up"
+    assert len(samples) >= buckets, (
+        f"only {len(samples)} usable frontier samples"
+    )
+    assert promoted != "gen-1", "updater never promoted a fresh delta"
+
+    # Bucket the samples along the staleness axis and average each bucket.
+    edges = np.linspace(samples[0]["staleness_s"],
+                        samples[-1]["staleness_s"], buckets + 1)
+    curve = []
+    for b in range(buckets):
+        sel = [s for s in samples
+               if edges[b] <= s["staleness_s"]
+               and (s["staleness_s"] < edges[b + 1] or b == buckets - 1)]
+        if not sel:
+            continue
+        curve.append(dict(
+            staleness_s=round(float(np.mean(
+                [s["staleness_s"] for s in sel])), 2),
+            frozen_auc=round(float(np.mean(
+                [s["frozen_auc"] for s in sel])), 4),
+            fresh_auc=round(float(np.mean(
+                [s["fresh_auc"] for s in sel])), 4),
+            samples=len(sel),
+        ))
+    decay = curve[0]["frozen_auc"] - curve[-1]["frozen_auc"]
+    end_lift = curve[-1]["fresh_auc"] - curve[-1]["frozen_auc"]
+    assert decay >= decay_bar, (
+        f"frontier failed to decay: {decay:.4f} < {decay_bar}"
+    )
+    assert end_lift >= lift_bar, (
+        f"fresh lane did not hold the line: {end_lift:.4f} < {lift_bar}"
+    )
+    return {
+        "metric": "staleness_frontier",
+        "unit": "auc_vs_staleness_s",
+        "value": round(float(decay), 4),
+        "curve": curve,
+        "frontier_decay": round(float(decay), 4),
+        "end_lift": round(float(end_lift), 4),
+        "primary_after": promoted,
+        "ok": ok_n,
+        "caller_errors": errors,
+        "retraces": retraces,
+        "smoke": smoke,
+    }
+
+
 def run_updater_shard_ab(smoke: bool = False) -> dict:
     """Sharded-updater A/B (--updater-shard-ab): the freshness plane's
     throughput must scale with updater shard count, without giving up ANY
@@ -6157,9 +6432,565 @@ def run_multichip() -> dict:
     return out
 
 
+def _experiment_world(root, smoke: bool, seed: int = 101):
+    """Deterministic world for the experiment soak: the same seed rebuilds
+    the IDENTICAL batches in any process — the SIGKILL resume worker
+    reconstructs trainer state from nothing but (root, smoke). Publishes
+    the gated gen-1 parent on first call for this root."""
+    import os
+
+    import jax.numpy as jnp
+
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        GameOptimizationConfig,
+        RandomEffectCoordinateConfig,
+        RegularizationConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.evaluation.suite import EvaluationSuite, EvaluatorSpec
+    from photon_tpu.experiment import (
+        ExperimentSpace,
+        IncrementalCandidateTrainer,
+    )
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.train.incremental import compute_holdout_metrics
+    from photon_tpu.types import TaskType
+
+    n_full = 384 if smoke else 1024
+    n_delta = 256 if smoke else 512
+    n_valid = 384 if smoke else 768
+    d_fix, d_re, E = 6, 4, 16
+
+    r = np.random.default_rng(seed)
+    w_fix_true = r.normal(size=d_fix).astype(np.float32)
+    w_re_true = (0.7 * r.normal(size=(E, d_re))).astype(np.float32)
+
+    def true_score(xf, xr, e):
+        return float(xf @ w_fix_true + xr @ w_re_true[e])
+
+    def mk(n, salt):
+        rr = np.random.default_rng(seed * 1000 + salt)
+        Xf = rr.normal(size=(n, d_fix)).astype(np.float32)
+        Xr = rr.normal(size=(n, d_re)).astype(np.float32)
+        users = rr.integers(0, E, size=n).astype(np.int32)
+        z = (Xf @ w_fix_true
+             + np.einsum("ij,ij->i", Xr, w_re_true[users])).astype(np.float32)
+        y = (rr.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+        return GameBatch(
+            label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+            weight=jnp.ones(n, jnp.float32),
+            features={"global": jnp.asarray(Xf), "per_user": jnp.asarray(Xr)},
+            entity_ids={"userId": jnp.asarray(users)},
+        )
+
+    full, delta, valid = mk(n_full, 1), mk(n_delta, 2), mk(n_valid, 3)
+    imaps = {
+        "global": IndexMap.build([f"g{j}" for j in range(d_fix)]),
+        "per_user": IndexMap.build([f"r{j}" for j in range(d_re)]),
+    }
+    eidx = EntityIndex()
+    for e in range(E):
+        eidx.intern(f"user{e}")
+    coord_configs = [
+        FixedEffectCoordinateConfig("global", "global"),
+        RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+    ]
+    suite = EvaluationSuite([EvaluatorSpec.parse("AUC")],
+                            num_entities={"userId": E})
+
+    if not os.path.isdir(os.path.join(root, "gen-1")):
+        for shard, imap in imaps.items():
+            imap.save(os.path.join(root, f"index-map-{shard}.json"))
+        eidx.save(os.path.join(root, "entity-index-userId.json"))
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs=coord_configs,
+            num_iterations=1, num_entities={"userId": E},
+        )
+        (res,) = est.fit(full)
+        g1 = os.path.join(root, "gen-1")
+        save_game_model(res.model, g1, imaps, {"userId": eidx},
+                        sparsity_threshold=0.0)
+        write_generation_manifest(
+            g1, parent=None,
+            holdout_metrics=compute_holdout_metrics(res.model, valid, suite),
+        )
+        gate = gate_and_publish(root, "gen-1")
+        assert gate.ok, gate.reason
+
+    trainer = IncrementalCandidateTrainer(
+        root, delta, imaps, {"userId": eidx},
+        TaskType.LOGISTIC_REGRESSION, coord_configs,
+        ["global", "per_user"],
+        valid_batch=valid, evaluation_suite=suite, num_iterations=1,
+    )
+    space = ExperimentSpace(
+        GameOptimizationConfig(reg={
+            "global": RegularizationConfig(weight=1.0),
+            "per_user": RegularizationConfig(weight=1.0),
+        }),
+        # The soak's useful λ live well inside the reference's full 1e±4
+        # span; a tighter box keeps the 2-round GP honest about finding
+        # the basin instead of burning proposals on absurd corners.
+        reg_weight_range=(1e-3, 1e3),
+    )
+    return dict(
+        d_fix=d_fix, d_re=d_re, E=E,
+        imaps=imaps, eidx=eidx, valid=valid,
+        trainer=trainer, space=space, true_score=true_score,
+    )
+
+
+def _holdout_logloss(model, batch) -> float:
+    """Offline mean logloss of a GAME model on a labeled batch."""
+    z = np.asarray(model.score(batch), np.float64)
+    y = np.asarray(batch.label, np.float64)
+    p = np.clip(1.0 / (1.0 + np.exp(-z)), 1e-7, 1.0 - 1e-7)
+    return float(np.mean(-(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))))
+
+
+def run_experiment_resume_worker(root: str, smoke: bool):
+    """Subprocess half of the experiment soak's SIGKILL drill: an
+    engine-less train-only manager for experiment id ``exp-resume``. The
+    parent launches this twice — first with a kill-plan at the
+    ``experiment.trained`` site (the process dies mid-round with durable
+    train records on disk), then clean (the rerun must re-propose the same
+    round and train only what the manifests do not already record)."""
+    from photon_tpu.experiment import ExperimentConfig, ExperimentManager
+
+    world = _experiment_world(root, smoke)
+    cfg = ExperimentConfig(
+        experiment_id="exp-resume", publish_root=root,
+        rounds=1, candidates_per_round=4, seed=23,
+    )
+    manager = ExperimentManager(cfg, world["space"], world["trainer"])
+    summary = manager.run(train_only=True)
+    print(json.dumps(summary), flush=True)
+
+
+def run_experiment_soak(smoke: bool = False):
+    """Continuous online experiment plane, end to end (ISSUE 20 tentpole
+    headline). A live engine serves gen-1 while a GP experiment runs
+    rounds of 4 warm-started candidate generations as CONCURRENT shadow
+    lanes, observed purely from the online quality plane, with one
+    injected-regression candidate that the quality burn must poison.
+
+    Acceptance:
+    - the GP winner's offline holdout loss is within tolerance of an
+      exhaustive offline λ sweep's best;
+    - ≥4 candidate versions resident at once, 0 post-warmup retraces;
+    - the injected-regression candidate is auto-poisoned by quality burn;
+    - 0 caller-visible scoring errors throughout;
+    - SIGKILL of a manager mid-round resumes without re-training the
+      candidates whose train records were already durable.
+    """
+    import os
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import threading
+
+    from photon_tpu.io.model_io import experiment_generations
+    from photon_tpu.experiment import ExperimentConfig, ExperimentManager
+    from photon_tpu.serve.batcher import ScoreRequest
+    from photon_tpu.serve.engine import ServeConfig, load_engine
+    from photon_tpu.stream.spool import FeedbackSpool, SpoolConfig
+    from photon_tpu.utils import faults
+    from photon_tpu.utils.faults import FaultPlan, FaultRule
+
+    root = tempfile.mkdtemp(prefix="photon-experiment-")
+    sdir = tempfile.mkdtemp(prefix="photon-experiment-spool-")
+    _progress("experiment soak: building world + gen-1 parent")
+    world = _experiment_world(root, smoke)
+    E, d_fix, d_re = world["E"], world["d_fix"], world["d_re"]
+
+    engine = load_engine(
+        os.path.join(root, "gen-1"), artifacts_dir=root,
+        config=ServeConfig(
+            max_batch_size=16, max_versions=8,
+            shadow_fraction=1.0, shadow_quality_fraction=1.0,
+        ),
+    )
+    spool = FeedbackSpool(sdir, SpoolConfig(
+        segment_max_records=256, sample_fraction=1.0, join_ttl_s=600.0,
+    ))
+    engine.attach_feedback(spool)
+
+    stats = dict(sent=0, errors=0, max_shadows=0, max_versions=0)
+    stop_evt = threading.Event()
+
+    def traffic():
+        rr = np.random.default_rng(777)
+        i = 0
+        while not stop_evt.is_set():
+            batch_futs = []
+            for _ in range(16):
+                e = int(rr.integers(0, E))
+                xf = rr.normal(size=d_fix).astype(np.float32)
+                xr = rr.normal(size=d_re).astype(np.float32)
+                uid = f"t-{i}"
+                i += 1
+                req = ScoreRequest(
+                    {"global": xf, "per_user": xr}, {"userId": f"user{e}"},
+                    uid=uid,
+                )
+                z_true = world["true_score"](xf, xr, e)
+                try:
+                    batch_futs.append((uid, engine.submit(req), z_true))
+                except Exception:
+                    stats["errors"] += 1
+            for uid, fut, z_true in batch_futs:
+                try:
+                    if not np.isfinite(float(fut.result(60.0))):
+                        stats["errors"] += 1
+                        continue
+                except Exception:
+                    stats["errors"] += 1
+                    continue
+                stats["sent"] += 1
+                y = float(rr.uniform() < 1.0 / (1.0 + np.exp(-z_true)))
+                engine.feedback_label(uid, y)
+            stats["max_shadows"] = max(
+                stats["max_shadows"], len(engine.shadow_versions)
+            )
+            stats["max_versions"] = max(
+                stats["max_versions"], len(engine.versions)
+            )
+            time.sleep(0.005)
+
+    t = threading.Thread(target=traffic, name="experiment-traffic",
+                         daemon=True)
+    t.start()
+
+    # One injected-regression candidate: the 3rd proposal of the run
+    # trains the pathologically over-regularized configuration.
+    faults.configure(FaultPlan(rules=(
+        FaultRule("experiment.regress", kind="permanent", at=(2,)),
+    )))
+    # In-process traffic joins thousands of labels per second, so a large
+    # window is cheap — and it keeps both burn verdicts out of estimation
+    # noise. The regressed lane's binned AUC sits only ~0.07 under primary
+    # (shrunk weights keep the score SIGN informative), so its reliable
+    # signature is the calibration collapse: logloss pinned at ln 2 ≈
+    # 0.693 vs primary ~0.60 — caught by a tight loss-ratio bound.
+    min_events = 800 if smoke else 1600
+    cfg = ExperimentConfig(
+        experiment_id="exp-soak", publish_root=root,
+        rounds=2, candidates_per_round=4, seed=7,
+        shadow_fraction=1.0, min_events=min_events,
+        observe_timeout_s=90.0 if smoke else 180.0,
+        observe_poll_s=0.2,
+        objective="loss", loss_burn_ratio=0.08, burn_checks=2,
+        metric_tolerance=0.1,
+    )
+    manager = ExperimentManager(cfg, world["space"], world["trainer"],
+                                engine=engine)
+    _progress("experiment soak: running 2 GP rounds × 4 shadow candidates")
+    try:
+        summary = manager.run()
+    finally:
+        faults.reset()
+        stop_evt.set()
+        t.join(timeout=10.0)
+
+    retraces = engine.retraces_since_warmup
+    primary = engine.model_version
+
+    # Offline exhaustive sweep: diagonal λ grid (same weight for both
+    # tuned coordinates), offline holdout loss per point — the reference's
+    # offline hyperparameter story the online winner must match.
+    from photon_tpu.estimators.config import (
+        GameOptimizationConfig,
+        RegularizationConfig,
+    )
+
+    grid = np.logspace(-3, 3, 4 if smoke else 7)
+    sweep = []
+    for i, lam in enumerate(grid):
+        gcfg = GameOptimizationConfig(reg={
+            "global": RegularizationConfig(weight=float(lam)),
+            "per_user": RegularizationConfig(weight=float(lam)),
+        })
+        mdir = world["trainer"].train(gcfg, f"sweep-{i}", {"sweep": True})
+        loss = _holdout_logloss(world["trainer"].load(mdir),
+                                world["valid"])
+        sweep.append(dict(weight=float(lam), holdout_logloss=round(loss, 6)))
+        _progress(f"experiment soak: sweep λ={lam:g} holdout {loss:.4f}")
+    sweep_best = min(s["holdout_logloss"] for s in sweep)
+
+    winner = summary.get("winner")
+    winner_loss = None
+    if winner:
+        winner_loss = _holdout_logloss(
+            world["trainer"].load(os.path.join(root, winner)),
+            world["valid"],
+        )
+    tol_rel, tol_abs = 0.15, 0.02
+    winner_ok = (
+        winner_loss is not None
+        and winner_loss <= sweep_best * (1.0 + tol_rel) + tol_abs
+    )
+
+    # The injected-regression candidate must be on the poison list with a
+    # quality-burn reason.
+    regressed = [
+        r for r in experiment_generations(root, "exp-soak")
+        if r.get("regressed")
+    ]
+    regressed_poisoned = bool(regressed) and all(
+        r["generation"] in summary["poisoned"]
+        and "quality burn" in str(r.get("poisonReason") or "")
+        for r in regressed
+    )
+
+    # SIGKILL resume drill (engine-less train-only manager, own id).
+    _progress("experiment soak: SIGKILL resume drill")
+    here = os.path.abspath(__file__)
+    cmd = [_sys.executable, here, "--experiment-resume-worker", root,
+           "1" if smoke else "0"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env[faults.FAULT_PLAN_ENV] = json.dumps({
+        "rules": [{"site": "experiment.trained", "kind": "kill", "at": [1]}],
+    })
+    p1 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=900)
+    killed = p1.returncode == -9
+    env.pop(faults.FAULT_PLAN_ENV)
+    p2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=900)
+    resume = {}
+    try:
+        resume = json.loads(p2.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        pass
+    resume_ok = (
+        killed and p2.returncode == 0
+        and resume.get("reused_trained") == 2
+        and resume.get("trained") == 2
+    )
+
+    engine.close(drain=True)
+    ok = (
+        bool(winner_ok)
+        and stats["errors"] == 0
+        and stats["max_shadows"] >= 4
+        and retraces == 0
+        and regressed_poisoned
+        and resume_ok
+    )
+    out = dict(
+        ok=bool(ok), smoke=smoke,
+        winner=winner,
+        winner_holdout_logloss=(
+            round(winner_loss, 6) if winner_loss is not None else None
+        ),
+        sweep_best_logloss=sweep_best,
+        winner_within_tolerance=bool(winner_ok),
+        sweep=sweep,
+        primary_after=os.path.basename(str(primary).rstrip("/")),
+        requests_sent=stats["sent"],
+        caller_errors=stats["errors"],
+        max_concurrent_shadows=stats["max_shadows"],
+        max_resident_versions=stats["max_versions"],
+        retraces_since_warmup=retraces,
+        poisoned=summary["poisoned"],
+        regressed_candidates=[r["generation"] for r in regressed],
+        regressed_poisoned=bool(regressed_poisoned),
+        resume=dict(
+            first_killed=bool(killed),
+            first_rc=p1.returncode,
+            second_rc=p2.returncode,
+            reused_trained=resume.get("reused_trained"),
+            trained_after_resume=resume.get("trained"),
+        ),
+        trained=summary["trained"],
+        reused=summary["reused_trained"] + summary["reused_observed"],
+        candidates=summary["candidates"],
+    )
+    shutil.rmtree(root, ignore_errors=True)
+    shutil.rmtree(sdir, ignore_errors=True)
+    return out
+
+
+def glm_family_traffic(task, z, rng):
+    """Task-consistent labels for link-scale scores ``z`` — the scenario
+    axis every traffic-driving bench shares: linear → gaussian residuals,
+    Poisson → counts from exp(z), classification (logistic / smoothed
+    hinge) → Bernoulli(sigmoid(z))."""
+    from photon_tpu.types import TaskType
+
+    z = np.asarray(z, np.float32)
+    if task == TaskType.LINEAR_REGRESSION:
+        return (z + 0.1 * rng.normal(size=z.shape)).astype(np.float32)
+    if task == TaskType.POISSON_REGRESSION:
+        return rng.poisson(np.exp(np.clip(z, -4.0, 3.0))).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-z))
+    return (rng.uniform(size=z.shape) < p).astype(np.float32)
+
+
+def run_glm_family(smoke: bool = False):
+    """Whole-family headline: every supported GLM task — LINEAR_REGRESSION,
+    LOGISTIC_REGRESSION, POISSON_REGRESSION,
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM — through train (coordinate descent
+    beats the null model's loss), serve (finite scores, zero caller
+    errors), and the streaming quality plane (label join lands in the
+    task's loss family with a finite windowed mean loss).
+
+    Acceptance (ISSUE 20 satellite): all four tasks pass all three legs.
+    """
+    import jax.numpy as jnp
+
+    from photon_tpu.data.game_data import GameBatch
+    from photon_tpu.data.index_map import EntityIndex, IndexMap
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        GameOptimizationConfig,
+        RandomEffectCoordinateConfig,
+        RegularizationConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.obs.quality import task_name
+    from photon_tpu.ops.losses import loss_for_task
+    from photon_tpu.serve.batcher import ScoreRequest
+    from photon_tpu.serve.engine import ServeConfig, ServingEngine
+    from photon_tpu.types import TaskType
+
+    n = 256 if smoke else 1024
+    n_serve = 32 if smoke else 128
+    d_fix, d_re, E = 6, 4, 16
+    tasks = [
+        TaskType.LINEAR_REGRESSION,
+        TaskType.LOGISTIC_REGRESSION,
+        TaskType.POISSON_REGRESSION,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+    ]
+    results = {}
+    for task in tasks:
+        r = np.random.default_rng(13)
+        Xf = r.normal(size=(n, d_fix)).astype(np.float32)
+        Xr = r.normal(size=(n, d_re)).astype(np.float32)
+        users = r.integers(0, E, size=n).astype(np.int32)
+        w_true = r.normal(size=d_fix).astype(np.float32)
+        z = (Xf @ w_true).astype(np.float32)
+        y = glm_family_traffic(task, z, r)
+
+        batch = GameBatch(
+            label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+            weight=jnp.ones(n, jnp.float32),
+            features={"g": jnp.asarray(Xf), "r": jnp.asarray(Xr)},
+            entity_ids={"userId": jnp.asarray(users)},
+        )
+        est = GameEstimator(
+            task=task,
+            coordinate_configs=[
+                FixedEffectCoordinateConfig("global", "g"),
+                RandomEffectCoordinateConfig("per_user", "userId", "r"),
+            ],
+            num_iterations=1,
+            num_entities={"userId": E},
+        )
+        (res,) = est.fit(batch, optimization_configs=[GameOptimizationConfig(
+            reg={"global": RegularizationConfig(weight=1.0),
+                 "per_user": RegularizationConfig(weight=10.0)},
+        )])
+        scores = np.asarray(res.model.score(batch), np.float32)
+        loss = loss_for_task(task)
+        fit_loss = float(np.mean(np.asarray(
+            loss.value(jnp.asarray(scores), batch.label))))
+        null_loss = float(np.mean(np.asarray(
+            loss.value(jnp.zeros(n, jnp.float32), batch.label))))
+
+        eidx = EntityIndex()
+        for e in range(E):
+            eidx.intern(f"u{e}")
+        eng = ServingEngine(
+            res.model, entity_indexes={"userId": eidx},
+            index_maps={
+                "g": IndexMap.build([f"g{j}" for j in range(d_fix)]),
+                "r": IndexMap.build([f"r{j}" for j in range(d_re)]),
+            },
+            config=ServeConfig(max_batch_size=16),
+            model_version=f"glm-{task.name}",
+        )
+        errors = 0
+        served = []
+        for i in range(n_serve):
+            req = ScoreRequest(
+                {"g": r.normal(size=d_fix).astype(np.float32),
+                 "r": r.normal(size=d_re).astype(np.float32)},
+                {"userId": f"u{i % E}"}, uid=f"req-{i}",
+            )
+            try:
+                s = float(eng.submit(req).result(60.0))
+                if not np.isfinite(s):
+                    errors += 1
+                served.append(s)
+            except Exception:
+                errors += 1
+                served.append(0.0)
+        zs = np.asarray(served, np.float32)
+        ys = glm_family_traffic(task, zs, r)
+        for i in range(n_serve):
+            eng.quality.observe(
+                score=float(zs[i]), label=float(ys[i]),
+                model_version=f"glm-{task.name}",
+            )
+        acc = None
+        for (version, _t, _re), a in eng.quality.window_totals().items():
+            if version == f"glm-{task.name}":
+                acc = a if acc is None else acc.merge(a)
+        mean_loss = acc.mean_loss() if acc is not None else None
+        eng.close()
+
+        ok = (
+            np.isfinite(fit_loss) and fit_loss < null_loss
+            and errors == 0
+            and mean_loss is not None and np.isfinite(mean_loss)
+        )
+        results[task.name] = dict(
+            ok=bool(ok),
+            family=task_name(task),
+            fit_loss=round(fit_loss, 6),
+            null_loss=round(null_loss, 6),
+            caller_errors=errors,
+            quality_events=int(acc.count) if acc is not None else 0,
+            quality_mean_loss=(
+                round(mean_loss, 6) if mean_loss is not None else None
+            ),
+        )
+        _progress(
+            f"glm family {task.name}: fit {fit_loss:.4f} < null "
+            f"{null_loss:.4f}, errors {errors}, online loss "
+            f"{mean_loss if mean_loss is None else round(mean_loss, 4)}"
+        )
+    all_ok = all(v["ok"] for v in results.values())
+    return dict(ok=all_ok, smoke=smoke, tasks=results)
+
+
 def main():
     import sys
 
+    if "--experiment-resume-worker" in sys.argv:
+        # Subprocess half of the experiment soak's SIGKILL resume drill:
+        # a train-only ExperimentManager the parent kills mid-round via
+        # a PHOTON_TPU_FAULT_PLAN kill rule, then reruns clean.
+        i = sys.argv.index("--experiment-resume-worker")
+        try:
+            root, smoke = sys.argv[i + 1], sys.argv[i + 2] == "1"
+        except IndexError:
+            print("usage: bench.py --experiment-resume-worker <root> <0|1>",
+                  file=sys.stderr)
+            sys.exit(2)
+        run_experiment_resume_worker(root, smoke)
+        return
     if "--multichip-worker" in sys.argv:
         # MUST dispatch before anything can touch jax: the worker forces
         # the virtual-device count as the process's first JAX operation.
@@ -6279,6 +7110,19 @@ def main():
         # bit-equivalence; CPU-measurable.
         print(json.dumps(run_streaming_soak()))
         return
+    if "--glm-family" in sys.argv:
+        print(json.dumps(run_glm_family(smoke="--smoke" in sys.argv)))
+        return
+    if "--experiment-soak" in sys.argv:
+        # Continuous online experiment plane: GP-EI rounds of 4 concurrent
+        # warm-started shadow candidates observed from the online quality
+        # plane; injected-regression candidate poisoned by quality burn,
+        # GP winner within tolerance of an offline exhaustive λ sweep,
+        # ≥4 resident candidate versions with zero post-warmup retraces,
+        # zero caller errors, SIGKILL-of-manager resume without
+        # re-training durable candidates.
+        print(json.dumps(run_experiment_soak(smoke="--smoke" in sys.argv)))
+        return
     if "--freshness-lift" in sys.argv:
         # Measured online AUC lift of fresh-delta serving over a frozen
         # pinned baseline under live drifting traffic, plus the
@@ -6286,6 +7130,14 @@ def main():
         # the in-settle promotion rolls back through the unchanged SLO
         # gate; zero caller errors, zero post-warmup retraces.
         print(json.dumps(run_freshness_lift(smoke="--smoke" in sys.argv)))
+        return
+    if "--staleness-frontier" in sys.argv:
+        # Accuracy-vs-staleness curve under drift: the frozen baseline
+        # lane's windowed online AUC at elapsed t IS the accuracy of a
+        # model t seconds stale; the streaming-fresh primary anchors the
+        # near-zero-staleness end. Frontier must decay, fresh must hold
+        # the line; zero caller errors, zero post-warmup retraces.
+        print(json.dumps(run_staleness_frontier(smoke="--smoke" in sys.argv)))
         return
     if "--updater-shard-ab" in sys.argv:
         # Sharded streaming updaters: live traffic spooled once, replayed

@@ -3,7 +3,7 @@
 # rat | unit | integration). Everything runs on a virtual 8-device CPU mesh
 # (tests/conftest.py forces it), so no accelerator is needed for correctness.
 #
-# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|fleet|rollout|streaming|exhaustion|obs|quality|install|kernels|all]   (default: all)
+# Usage: ./ci.sh [static|unit|dryrun|telemetry|active-set|ooc|serve|faults|soak|fleet|rollout|streaming|exhaustion|obs|quality|experiments|install|kernels|all]   (default: all)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -640,6 +640,31 @@ run_quality() {
     echo "   freshness-lift smoke OK"
 }
 
+run_experiments() {
+    # Continuous online experiment plane (ISSUE 20): GP proposal
+    # determinism + search-history serialization round-trip + crash-resume
+    # from durable manifest records (tests/test_experiment.py), and the
+    # GLM family audit — EVERY task type (linear, logistic, Poisson,
+    # smoothed hinge) through train → serve → stream → rollout with the
+    # family's own quality-plane loss (tests/test_glm_family.py). Then the
+    # live smokes: the GLM-family traffic drill across all four task
+    # types, and the experiment soak — a GP-driven sweep holding 4
+    # concurrent shadow candidates under live traffic, quality-burn
+    # poisoning of an injected regression, SIGKILL of the manager
+    # mid-round resuming without re-training, and the GP winner landing
+    # within tolerance of an offline exhaustive λ sweep.
+    echo "== experiments: GP determinism + resume + GLM family tests =="
+    JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+        tests/test_experiment.py tests/test_glm_family.py
+    echo "   experiment + GLM family tests OK"
+    echo "== experiments: GLM family traffic smoke (all task types) =="
+    JAX_PLATFORMS=cpu python bench.py --glm-family --smoke
+    echo "   glm-family smoke OK"
+    echo "== experiments: GP live-sweep soak smoke =="
+    JAX_PLATFORMS=cpu python bench.py --experiment-soak --smoke
+    echo "   experiment-soak smoke OK"
+}
+
 run_kernels() {
     # Kernel-surface smoke: interpret-mode parity for both Pallas kernel
     # families (FE fused value+grad/HVP, RE batched Newton system), and a
@@ -689,7 +714,7 @@ run_install() {
                photon-tpu-train-glm photon-tpu-feature-indexing \
                photon-tpu-name-and-term-bags photon-tpu-game-serving \
                photon-tpu-game-incremental photon-tpu-game-streaming \
-               photon-tpu-obs; do
+               photon-tpu-game-experiment photon-tpu-obs; do
         PYTHONPATH="$parent_site" "$tmp/venv/bin/$cmd" --help > /dev/null
         echo "   $cmd --help OK"
     done
@@ -711,6 +736,13 @@ run_install() {
     PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-obs" \
         quality --help > /dev/null
     echo "   quality-plane CLI surfaces OK (--late-replay-cadence/--fe-retrain/quality)"
+    # Experiment-plane surfaces (ISSUE 20): the sweep driver's core flags
+    # and the experiments rollup on the obs CLI.
+    PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-game-experiment" \
+        --help | grep -q -- "--rounds"
+    PYTHONPATH="$parent_site" "$tmp/venv/bin/photon-tpu-obs" \
+        experiments --help > /dev/null
+    echo "   experiment-plane CLI surfaces OK (--rounds/experiments)"
     rm -rf "$tmp"
 }
 
@@ -733,7 +765,8 @@ case "$stage" in
     kernels) run_kernels ;;
     obs) run_obs ;;
     quality) run_quality ;;
-    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_fleet; run_rollout; run_streaming; run_exhaustion; run_obs; run_quality; run_kernels; run_unit ;;
+    experiments) run_experiments ;;
+    all) run_static; run_native; run_install; run_dryrun; run_telemetry; run_active_set; run_ooc; run_serve; run_faults; run_soak; run_fleet; run_rollout; run_streaming; run_exhaustion; run_obs; run_quality; run_experiments; run_kernels; run_unit ;;
     *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
 echo "CI ($stage) PASSED"

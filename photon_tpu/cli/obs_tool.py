@@ -394,6 +394,69 @@ def cmd_slo(args: argparse.Namespace) -> int:
 
 
 # ---------------------------------------------------------------------------
+# experiments
+# ---------------------------------------------------------------------------
+
+
+def _render_experiments(doc: dict) -> int:
+    exps = doc.get("experiments") or []
+    if not exps:
+        print(
+            "(no experiment generations — nothing under the publish root "
+            "carries an `experiment` manifest tag)"
+        )
+        return 1
+    for exp in exps:
+        print(
+            f"experiment {exp.get('id')}: rounds={exp.get('rounds')}"
+            f" candidates={len(exp.get('candidates') or [])}"
+            f" poisoned={len(exp.get('poisoned') or [])}"
+        )
+        for c in exp.get("candidates") or []:
+            obs = c.get("observation")
+            obs_s = f"{obs:.6f}" if isinstance(obs, (int, float)) else "–"
+            flags = []
+            if c.get("poisoned"):
+                flags.append(f"POISONED({c.get('poisonReason', '?')})")
+            if c.get("winner"):
+                flags.append("WINNER")
+            print(
+                f"  r{c.get('round')} {c.get('paramsKey')}"
+                f"  gen={c.get('generation')}"
+                f"  obs={obs_s}"
+                f"  {' '.join(flags)}".rstrip()
+            )
+        best = exp.get("best")
+        if best:
+            print(
+                f"  best: {best.get('generation')}"
+                f" obs={best.get('observation')}"
+            )
+    return 0
+
+
+def cmd_experiments(args: argparse.Namespace) -> int:
+    if args.publish_root:
+        # Offline rollup straight from the generation manifests — works on
+        # the publish root with no server running (the manifests ARE the
+        # experiment store).
+        from photon_tpu.experiment import experiment_summary
+
+        doc = experiment_summary(args.publish_root)
+    else:
+        url = args.url.rstrip("/") + "/v1/experiment"
+        try:
+            doc = _get_json(url)
+        except (urllib.error.URLError, OSError) as exc:
+            print(f"photon-tpu-obs: {url}: {exc}", file=sys.stderr)
+            return 1
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    return _render_experiments(doc)
+
+
+# ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
 
@@ -446,6 +509,19 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--json", action="store_true",
                    help="raw slo + telemetry_sink blocks as JSON")
     s.set_defaults(fn=cmd_slo)
+
+    e = sub.add_parser(
+        "experiments",
+        help="per-experiment candidate lifecycle rollup (rounds, "
+             "observations, poisons, winner) from a live /v1/experiment "
+             "endpoint or straight from a publish root's manifests",
+    )
+    e.add_argument("--publish-root", default=None,
+                   help="read generation manifests from this dir instead "
+                        "of hitting --url (works with no server running)")
+    e.add_argument("--json", action="store_true",
+                   help="rollup as one JSON document")
+    e.set_defaults(fn=cmd_experiments)
     return p
 
 

@@ -1,0 +1,21 @@
+"""Continuous online experiment plane (see manager.py for the design)."""
+
+from photon_tpu.experiment.manager import (
+    Candidate,
+    ExperimentConfig,
+    ExperimentManager,
+    ExperimentSpace,
+    IncrementalCandidateTrainer,
+    experiment_summary,
+    point_key,
+)
+
+__all__ = [
+    "Candidate",
+    "ExperimentConfig",
+    "ExperimentManager",
+    "ExperimentSpace",
+    "IncrementalCandidateTrainer",
+    "experiment_summary",
+    "point_key",
+]

@@ -20,7 +20,7 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -417,6 +417,61 @@ def load_generation_manifest(model_dir: str) -> Optional[dict]:
         return None
     with open(path) as f:
         return json.load(f)
+
+
+def update_generation_manifest(model_dir: str, patch: dict) -> Optional[dict]:
+    """Durably merge top-level keys into an existing generation manifest.
+    The manifest is excluded from its own checksum record, so a metadata
+    patch (e.g. the experiment plane stamping an online observation into
+    its ``experiment`` tag) never invalidates the gate's checksum pass.
+    Returns the merged manifest, or None when the directory has none."""
+    manifest = load_generation_manifest(model_dir)
+    if manifest is None:
+        return None
+    for key, val in patch.items():
+        if (isinstance(val, dict) and isinstance(manifest.get(key), dict)):
+            manifest[key] = {**manifest[key], **val}
+        else:
+            manifest[key] = val
+    _write_json_durable(os.path.join(model_dir, MANIFEST_FILE), manifest)
+    return manifest
+
+
+def experiment_generations(
+    publish_root: str, experiment_id: Optional[str] = None
+) -> List[dict]:
+    """Every generation manifest under ``publish_root`` carrying an
+    ``experiment`` tag (optionally filtered to one experiment id), sorted
+    by (round, generation). Each entry is the manifest's experiment block
+    plus ``generation`` / ``gate`` / ``createdAt`` — the crash-safe record
+    a resuming ExperimentManager (and the obs rollup) reconstructs rounds
+    from; the manifests ARE the experiment store, there is no side file to
+    lose."""
+    out: List[dict] = []
+    try:
+        names = sorted(os.listdir(publish_root))
+    except OSError:
+        return out
+    for name in names:
+        model_dir = os.path.join(publish_root, name)
+        if not os.path.isdir(model_dir):
+            continue
+        manifest = load_generation_manifest(model_dir)
+        if not manifest:
+            continue
+        exp = manifest.get("experiment")
+        if not isinstance(exp, dict):
+            continue
+        if experiment_id is not None and exp.get("id") != experiment_id:
+            continue
+        out.append(dict(
+            exp,
+            generation=manifest.get("generation", name),
+            gate=manifest.get("gate"),
+            createdAt=manifest.get("createdAt"),
+        ))
+    out.sort(key=lambda e: (int(e.get("round", 0)), str(e["generation"])))
+    return out
 
 
 def delta_info(model_dir: str) -> Optional[dict]:

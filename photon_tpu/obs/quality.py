@@ -174,6 +174,11 @@ class QualityAccumulator:
             mu = max(pred, eps)
             term = y * math.log(y / mu) if y > 0 else 0.0
             self.loss_sum += w * 2.0 * (term - (y - mu))
+        elif task == "linear":
+            # Squared error — the identity-link prediction is unbounded and
+            # the label is real-valued, so the logloss clamp below would
+            # destroy both.
+            self.loss_sum += w * (pred - y) ** 2
         else:
             p = min(1.0 - eps, max(eps, pred))
             self.loss_sum += w * -(y * math.log(p) + (1.0 - y) * math.log(1.0 - p))

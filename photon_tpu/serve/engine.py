@@ -47,7 +47,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -92,6 +92,10 @@ class ServeConfig:
     admission: Optional[AdmissionConfig] = None  # per-tenant quotas/classes
     max_versions: int = 2  # resident generations (primary + candidates)
     shadow_fraction: float = 0.0  # of primary traffic re-scored on shadow
+    # Fraction of label-joined records re-scored on EACH shadow candidate
+    # (its online-quality lane). 1.0 gives every candidate a dense
+    # (score, label) stream — the experiment plane's GP observations.
+    shadow_quality_fraction: float = 1.0
     # A promotion is "settled" (rollback parent unpinned, breaker-trip
     # monitoring window closed) this many seconds after promote(). <= 0
     # keeps the parent pinned until the next promote/rollback.
@@ -178,6 +182,39 @@ class _State:
     warm_traces: int  # trace_count right after warm-up
 
 
+class _ShadowLane:
+    """Per-candidate shadow accounting. Each resident candidate that
+    shadows primary traffic owns one lane: its own traffic fraction, its
+    own fractional-sampling accumulator (the N-way split stays exact and
+    RNG-free — candidate ``i`` at fraction ``f`` scores every ``1/f``-th
+    primary request regardless of what the other lanes sample), and its
+    own divergence record so N concurrent candidates never alias into one
+    series."""
+
+    __slots__ = ("fraction", "acc", "count", "div_sum", "div_max",
+                 "samples", "quality_acc", "started_at", "seq")
+
+    def __init__(self, fraction: float, seq: int):
+        self.fraction = float(fraction)
+        self.acc = 0.0  # divergence-sampling accumulator
+        self.count = 0
+        self.div_sum = 0.0
+        self.div_max = 0.0
+        self.samples: deque = deque(maxlen=256)
+        self.quality_acc = 0.0  # label re-score accumulator (quality lane)
+        self.started_at = time.time()
+        self.seq = seq  # start order; highest = "the" shadow for legacy API
+
+    def stats(self, version: str) -> Dict:
+        return dict(
+            version=version,
+            fraction=self.fraction,
+            count=self.count,
+            max_divergence=self.div_max,
+            mean_divergence=self.div_sum / self.count if self.count else 0.0,
+        )
+
+
 class ServingEngine:
     """In-process serving core; cli/game_serving.py adds the HTTP front end.
 
@@ -224,19 +261,19 @@ class ServingEngine:
         # Multi-version residency: every generation is a full _State (its own
         # store + transformer + warm-up), but versions differ only by table
         # VALUES, so marginal versions cost memory — never a live-path
-        # compile. ``_primary`` answers unpinned traffic; ``_shadow``, when
-        # set, re-scores a deterministic sample of primary traffic without
-        # touching responses.
+        # compile. ``_primary`` answers unpinned traffic; ``_shadows`` maps
+        # candidate version → lane: each lane re-scores its own deterministic
+        # fraction of primary traffic (independent fractional accumulators,
+        # so an N-way split stays exact and RNG-free) without touching
+        # responses. The single-shadow rollout API (start_shadow /
+        # stop_shadow / shadow_stats with no argument) operates on the most
+        # recently started lane.
         state = self._build_state(model, model_version)
         self._states: Dict[str, _State] = {state.model_version: state}
         self._primary: str = state.model_version
-        self._shadow: Optional[str] = None
+        self._shadows: Dict[str, _ShadowLane] = {}
+        self._shadow_seq = 0  # start order; newest lane answers legacy API
         self._shadow_fraction = float(self.config.shadow_fraction)
-        self._shadow_acc = 0.0  # fractional-sampling accumulator
-        self._shadow_samples: deque = deque(maxlen=256)
-        self._shadow_count = 0
-        self._shadow_div_sum = 0.0
-        self._shadow_div_max = 0.0
         self._promotion: Optional[Dict] = None
         # Feedback spool (streaming freshness loop): when attached, every
         # scored primary request is offered to the spool's label join.
@@ -533,7 +570,7 @@ class ServingEngine:
                 scores = self._score_on(self._states[key], sub)
                 out[idxs] = scores
                 if key == self._primary:
-                    if self._shadow in self._states:
+                    if self._shadows:
                         self._maybe_shadow_score(sub, scores)
                     if self._feedback is not None:
                         self._record_feedback(sub, scores)
@@ -575,41 +612,59 @@ class ServingEngine:
         shadow failure degrades to "no sample", never to a caller error.
 
         Fault site ``serve.shadow_diverge`` perturbs the shadow scores so
-        the watcher's divergence bound must refuse the candidate."""
-        take: List[int] = []
-        for i in range(len(requests)):
-            self._shadow_acc += self._shadow_fraction
-            if self._shadow_acc >= 1.0:
-                self._shadow_acc -= 1.0
-                take.append(i)
-        if not take:
-            return
-        state = self._states[self._shadow]
-        try:
-            shadow_scores = np.asarray(
-                self._score_on(state, [requests[i] for i in take]), np.float32
-            )
-        except Exception as exc:  # noqa: BLE001 — shadow never hurts callers
-            registry().counter("serve_shadow_errors_total").inc()
-            logger.warning(
-                "serving: shadow scoring on %r failed: %s", self._shadow, exc
-            )
-            return
-        if faults.injector().fire("serve.shadow_diverge") is not None:
-            shadow_scores = shadow_scores + 1.0
+        the watcher's divergence bound must refuse the candidate. With N
+        concurrent lanes the fault takes the candidate basename as its
+        label, so a plan can regress one candidate and leave the rest."""
         reg = registry()
-        hist = reg.histogram("serve_shadow_divergence")
-        for j, i in enumerate(take):
-            p, s = float(primary_scores[i]), float(shadow_scores[j])
-            div = abs(s - p)
-            hist.observe(div)
-            self._shadow_count += 1
-            self._shadow_div_sum += div
-            self._shadow_div_max = max(self._shadow_div_max, div)
-            self._shadow_samples.append(
-                dict(uid=requests[i].uid, primary=p, shadow=s, divergence=div)
-            )
-        reg.counter("serve_shadow_scored_total").inc(len(take))
+        for key, lane in list(self._shadows.items()):
+            if key not in self._states:
+                continue  # lane outlived its generation (evict race)
+            take: List[int] = []
+            for i in range(len(requests)):
+                lane.acc += lane.fraction
+                if lane.acc >= 1.0:
+                    lane.acc -= 1.0
+                    take.append(i)
+            if not take:
+                continue
+            short = os.path.basename(key.rstrip("/"))
+            state = self._states[key]
+            try:
+                shadow_scores = np.asarray(
+                    self._score_on(state, [requests[i] for i in take]),
+                    np.float32,
+                )
+            except Exception as exc:  # noqa: BLE001 — never hurts callers
+                reg.counter(
+                    "serve_shadow_errors_total", model_version=short
+                ).inc()
+                logger.warning(
+                    "serving: shadow scoring on %r failed: %s", key, exc
+                )
+                continue
+            if faults.injector().fire(
+                "serve.shadow_diverge", label=short
+            ) is not None:
+                shadow_scores = shadow_scores + 1.0
+            # The candidate label keeps N concurrent shadow series apart —
+            # an unlabeled serve_shadow_divergence would alias every lane
+            # into one histogram.
+            hist = reg.histogram("serve_shadow_divergence",
+                                 model_version=short)
+            for j, i in enumerate(take):
+                p, s = float(primary_scores[i]), float(shadow_scores[j])
+                div = abs(s - p)
+                hist.observe(div)
+                lane.count += 1
+                lane.div_sum += div
+                lane.div_max = max(lane.div_max, div)
+                lane.samples.append(
+                    dict(uid=requests[i].uid, primary=p, shadow=s,
+                         divergence=div)
+                )
+            reg.counter(
+                "serve_shadow_scored_total", model_version=short
+            ).inc(len(take))
 
     # -- public API ---------------------------------------------------------
 
@@ -696,7 +751,22 @@ class ServingEngine:
 
     @property
     def shadow_version(self) -> Optional[str]:
-        return self._shadow
+        """The most recently started shadow candidate (legacy single-shadow
+        view); None when no lane is active."""
+        lane = self._newest_shadow_locked()
+        return lane[0] if lane else None
+
+    @property
+    def shadow_versions(self) -> List[str]:
+        """All active shadow candidates, oldest lane first."""
+        with self._lock:
+            return sorted(self._shadows, key=lambda k: self._shadows[k].seq)
+
+    def _newest_shadow_locked(self) -> Optional[Tuple[str, "_ShadowLane"]]:
+        if not self._shadows:
+            return None
+        key = max(self._shadows, key=lambda k: self._shadows[k].seq)
+        return key, self._shadows[key]
 
     @property
     def retraces_since_warmup(self) -> int:
@@ -737,7 +807,8 @@ class ServingEngine:
         than drop any of those."""
         cap = max(int(self.config.max_versions), 1)
         self._maybe_settle_promotion_locked()
-        keep = {self._primary, self._shadow, protect, self._quality_baseline}
+        keep = {self._primary, protect, self._quality_baseline}
+        keep.update(self._shadows)  # every live candidate lane stays pinned
         if self._promotion is not None:
             keep.add(self._promotion["parent"])
         for key in list(self._states):
@@ -923,12 +994,14 @@ class ServingEngine:
             trace_id=trace_id,
             slo=self.slo,
         )
-        base = self._quality_baseline
-        if base is None:
-            return
         rec_version = os.path.basename(
             str(rec.get("modelVersion") or "").rstrip("/")
         )
+        self._candidate_quality_lanes(rec, label, tenant, re_type,
+                                      trace_id, rec_version)
+        base = self._quality_baseline
+        if base is None:
+            return
         if rec_version == os.path.basename(str(base).rstrip("/")):
             return  # the baseline scored it already — no second lane
         self._quality_acc += self._quality_fraction
@@ -957,6 +1030,55 @@ class ServingEngine:
         )
         registry().counter("quality_baseline_scored_total").inc()
 
+    def _candidate_quality_lanes(
+        self, rec: dict, label: float, tenant, re_type: str, trace_id,
+        rec_version: str,
+    ) -> None:
+        """Re-score one joined label on EVERY active shadow candidate and
+        feed the quality plane under that candidate's version key — the
+        per-candidate streaming AUC/deviance the experiment plane's GP
+        observes. Observability-only (a failure degrades to no sample), no
+        SLO feed: a bad CANDIDATE must burn its own quality series and get
+        poisoned, never page the primary's gate."""
+        if not self._shadows:
+            return
+        frac = float(self.config.shadow_quality_fraction)
+        if frac <= 0.0:
+            return
+        for key, lane in list(self._shadows.items()):
+            short = os.path.basename(str(key).rstrip("/"))
+            if short == rec_version:
+                continue  # the candidate scored it already (pinned traffic)
+            lane.quality_acc += frac
+            if lane.quality_acc < 1.0:
+                continue
+            lane.quality_acc -= 1.0
+            try:
+                score = self._baseline_score(rec, key)
+            except Exception as exc:  # noqa: BLE001 — never hurts callers
+                registry().counter(
+                    "quality_candidate_errors_total", model_version=short
+                ).inc()
+                logger.warning(
+                    "serving: candidate quality re-score on %r failed: %s",
+                    key, exc,
+                )
+                continue
+            self.quality.observe(
+                score=score,
+                label=label,
+                model_version=key,
+                tenant=tenant,
+                re_type=re_type,
+                ts=rec.get("ts"),
+                label_ts=rec.get("labelTs"),
+                trace_id=trace_id,
+                slo=None,  # candidate lanes never feed the global gate
+            )
+            registry().counter(
+                "quality_candidate_scored_total", model_version=short
+            ).inc()
+
     def _baseline_score(self, rec: dict, base: str) -> float:
         """Score one spool record's features on the pinned baseline
         generation, bypassing admission and the SLO request feed (an
@@ -976,47 +1098,75 @@ class ServingEngine:
     def start_shadow(
         self, model_version: str, fraction: Optional[float] = None
     ) -> None:
-        """Mirror a sample of primary traffic onto a resident candidate.
-        Resets the divergence record so a quota check reads this shadow
+        """Mirror a deterministic sample of primary traffic onto a resident
+        candidate. Each call ADDS a lane (or resets an existing one), so N
+        candidates can shadow concurrently — each with its own fraction,
+        accumulator, and divergence record; the no-argument legacy API
+        (``stop_shadow()`` / ``shadow_stats()`` / ``shadow_version``)
+        addresses the most recently started lane. Starting an already
+        shadowing version resets its record so a quota check reads the new
         phase only."""
         with self._lock:
             key = self._resolve_version(model_version)
             if key == self._primary:
                 raise ValueError("cannot shadow the primary onto itself")
-            self._shadow = key
-            if fraction is not None:
-                self._shadow_fraction = float(fraction)
-            self._shadow_acc = 0.0
-            self._shadow_samples.clear()
-            self._shadow_count = 0
-            self._shadow_div_sum = 0.0
-            self._shadow_div_max = 0.0
+            frac = float(fraction) if fraction is not None \
+                else self._shadow_fraction
+            self._shadow_fraction = frac
+            self._shadow_seq += 1
+            self._shadows[key] = _ShadowLane(frac, self._shadow_seq)
         logger.info(
-            "serving: shadowing %.3f of primary traffic onto %r",
-            self._shadow_fraction, key,
+            "serving: shadowing %.3f of primary traffic onto %r "
+            "(%d concurrent lane(s))", frac, key, len(self._shadows),
         )
 
-    def stop_shadow(self) -> None:
+    def stop_shadow(self, model_version: Optional[str] = None) -> None:
+        """Stop one candidate's lane, or EVERY lane when no version is
+        given (the legacy single-shadow call)."""
         with self._lock:
-            self._shadow = None
+            if model_version is None:
+                self._shadows.clear()
+                return
+            key = self._resolve_version(model_version)
+            self._shadows.pop(key, None)
 
-    def shadow_stats(self) -> Dict:
-        return dict(
-            version=self._shadow,
-            count=self._shadow_count,
-            max_divergence=self._shadow_div_max,
-            mean_divergence=(
-                self._shadow_div_sum / self._shadow_count
-                if self._shadow_count
-                else 0.0
-            ),
-        )
+    def shadow_stats(self, model_version: Optional[str] = None) -> Dict:
+        """Divergence record for one candidate lane (``model_version``), or
+        the legacy single-shadow view: the most recently started lane's
+        record plus a ``candidates`` map carrying EVERY lane keyed by
+        version — N concurrent shadows never alias into one series."""
+        with self._lock:
+            if model_version is not None:
+                key = self._resolve_version(model_version)
+                lane = self._shadows.get(key)
+                if lane is None:
+                    return dict(version=None, count=0,
+                                max_divergence=0.0, mean_divergence=0.0)
+                return lane.stats(key)
+            per_lane = {
+                k: lane.stats(k) for k, lane in self._shadows.items()
+            }
+            newest = self._newest_shadow_locked()
+            if newest is None:
+                return dict(version=None, count=0, max_divergence=0.0,
+                            mean_divergence=0.0, candidates=per_lane)
+            out = newest[1].stats(newest[0])
+            out["candidates"] = per_lane
+            return out
 
-    def shadow_samples(self) -> List[Dict]:
+    def shadow_samples(
+        self, model_version: Optional[str] = None
+    ) -> List[Dict]:
         """Recent (uid, primary, shadow) score pairs — the rollout soak's
-        bit-exactness evidence."""
+        bit-exactness evidence. One lane's samples when ``model_version``
+        is given, else the most recently started lane's."""
         with self._lock:
-            return list(self._shadow_samples)
+            if model_version is not None:
+                key = self._resolve_version(model_version)
+                lane = self._shadows.get(key)
+                return list(lane.samples) if lane else []
+            newest = self._newest_shadow_locked()
+            return list(newest[1].samples) if newest else []
 
     def promote(self, model_version: str) -> Dict:
         """Make a resident generation the primary, remembering the previous
@@ -1036,8 +1186,7 @@ class ServingEngine:
                 trips_at=self._total_trips(),
             )
             self._primary = key
-            if self._shadow == key:
-                self._shadow = None
+            self._shadows.pop(key, None)  # a primary never shadows itself
             self._last_model_update = time.time()  # SLO staleness clock
         registry().counter("serve_promotions_total").inc()
         logger.info("serving: promoted %r (parent %r)", key, parent)
@@ -1071,7 +1220,7 @@ class ServingEngine:
             demoted = self._primary
             self._primary = promo["parent"]
             self._promotion = None
-            self._shadow = None
+            self._shadows.clear()
         registry().counter("serve_rollbacks_total").inc()
         logger.warning(
             "serving: rolled back %r -> %r (%s)",
@@ -1148,7 +1297,8 @@ class ServingEngine:
             model_version=state.model_version,
             versions=sorted(self._states),
             primary=self._primary,
-            shadow=self._shadow,
+            shadow=self.shadow_version,
+            shadows=self.shadow_versions,
             shadow_stats=self.shadow_stats(),
             promotion=dict(promo) if promo else None,
             trips_since_promotion=trips,
@@ -1255,7 +1405,7 @@ def load_engine(
     model = load_resolved_game_model(
         model_dir, index_maps, entity_indexes, to_device=False
     )
-    return ServingEngine(
+    engine = ServingEngine(
         model,
         entity_indexes=entity_indexes,
         index_maps=index_maps,
@@ -1263,3 +1413,7 @@ def load_engine(
         model_version=model_version or model_dir.rstrip("/"),
         partition=partition,
     )
+    # The publish root this engine was loaded from: generation manifests
+    # live here, which is what the /v1/experiment rollup reads.
+    engine.artifacts_dir = artifacts
+    return engine

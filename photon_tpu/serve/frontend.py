@@ -485,6 +485,10 @@ class ScorerServer:
                 ))
             elif op == "metrics":
                 out.put(dict(id=rid, ok=True, result=self._op_metrics(msg)))
+            elif op == "experiment":
+                out.put(dict(
+                    id=rid, ok=True, result=self._op_experiment(msg),
+                ))
             elif op == "traces":
                 out.put(dict(id=rid, ok=True, result=self._op_traces(msg)))
             elif op == "ping":
@@ -551,6 +555,9 @@ class ScorerServer:
 
     def _op_feedback(self, msg: dict) -> dict:
         return apply_feedback(self.engine, msg.get("body") or {})
+
+    def _op_experiment(self, msg: dict) -> dict:
+        return experiment_rollup(self.engine)
 
     def _op_metrics(self, msg: dict) -> List[dict]:
         """Registry snapshot for the worker-side ``/metrics`` merge.
@@ -753,6 +760,36 @@ class ScorerClient:
 # ---------------------------------------------------------------------------
 
 
+def experiment_rollup(engine) -> dict:
+    """``/v1/experiment`` payload: the manifest-derived experiment rollup
+    for the publish root this engine serves from (the manifests ARE the
+    experiment store — a dead manager leaves a readable history), plus the
+    engine's LIVE candidate state (resident shadow lanes and their
+    divergence counters), which manifests can't know."""
+    from photon_tpu.experiment import experiment_summary
+
+    root = getattr(engine, "artifacts_dir", None)
+    if not root:
+        version = str(getattr(engine, "model_version", "") or "")
+        parent = os.path.dirname(version.rstrip("/"))
+        root = parent if os.path.isdir(parent) else None
+    doc: dict = {"publishRoot": root, "experiments": []}
+    if root:
+        try:
+            doc.update(experiment_summary(root))
+        except Exception as exc:  # noqa: BLE001 — rollup is best-effort
+            doc["error"] = str(exc)
+    try:
+        doc["live"] = {
+            "primary": engine.model_version,
+            "shadows": engine.shadow_versions,
+            "shadowStats": engine.shadow_stats(),
+        }
+    except Exception:  # noqa: BLE001 — a closing engine must not 500 this
+        pass
+    return doc
+
+
 class LocalBackend:
     """Direct engine access — the single-process deployment shape."""
 
@@ -836,6 +873,9 @@ class LocalBackend:
     def feedback(self, body: dict) -> dict:
         return apply_feedback(self.engine, body)
 
+    def experiment(self) -> dict:
+        return experiment_rollup(self.engine)
+
 
 class RemoteBackend:
     """Scorer access over the IPC channel — the worker deployment shape."""
@@ -899,6 +939,9 @@ class RemoteBackend:
 
     def feedback(self, body: dict) -> dict:
         return self.client.call("feedback", timeout_s=30.0, body=body)
+
+    def experiment(self) -> dict:
+        return self.client.call("experiment", timeout_s=30.0)
 
 
 def make_http_handler(backend):
@@ -977,6 +1020,8 @@ def make_http_handler(backend):
                             limit=self._query_int("limit")
                         ),
                     })
+                elif route == "/v1/experiment":
+                    self._reply_json(200, backend.experiment())
                 else:
                     self._reply_json(404, {"error": f"no route {self.path}"})
             except Exception as exc:  # noqa: BLE001 — classified below

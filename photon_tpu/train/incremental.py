@@ -225,6 +225,7 @@ def incremental_update(
     emit_delta: bool = False,
     extra_manifest: Optional[dict] = None,
     serialize_publish: bool = False,
+    optimization_config=None,
 ) -> IncrementalResult:
     """One incremental generation, end to end: warm-start train on the
     delta ``batch`` → merge over the parent → save → manifest → gate →
@@ -242,6 +243,11 @@ def incremental_update(
     generation artifact. Falls back to a full publish when there is no
     parent or nothing qualifies for a layer. ``extra_manifest`` merges extra
     keys into the generation manifest (e.g. the stream consume cursor).
+
+    ``optimization_config`` (a :class:`GameOptimizationConfig`) overrides
+    the coordinate configs' own regularization grid with ONE explicit
+    point — the experiment plane trains each GP-proposed candidate at
+    exactly its proposed λ instead of sweeping the base grid.
 
     ``serialize_publish=True`` runs the save→manifest→gate tail under the
     publish root's :func:`~photon_tpu.io.model_io.publish_lock` and REBASES
@@ -311,6 +317,9 @@ def incremental_update(
             evaluation_suite if valid_batch is not None else None
         ),
         initial_model=parent,
+        optimization_configs=(
+            [optimization_config] if optimization_config is not None else None
+        ),
     )
     best = (
         estimator.select_best(results, evaluation_suite)

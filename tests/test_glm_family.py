@@ -1,0 +1,311 @@
+"""GLM family end-to-end audit (ISSUE 20 satellite).
+
+Every supported task type — linear, logistic, Poisson, smoothed hinge —
+through the full loop: train (GameEstimator coordinate descent), serve
+(ServingEngine scoring), stream (feedback label join → online quality
+plane with the task's loss family), and rollout (generation manifest
+gate + shadow + promote). The quality plane's per-family loss semantics
+are pinned here: logloss for the classification family, deviance for
+Poisson, squared error for linear.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_tpu.data.game_data import GameBatch
+from photon_tpu.data.index_map import EntityIndex, IndexMap
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.obs.quality import predict, task_name
+from photon_tpu.types import TaskType
+
+ALL_TASKS = [
+    TaskType.LINEAR_REGRESSION,
+    TaskType.LOGISTIC_REGRESSION,
+    TaskType.POISSON_REGRESSION,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+]
+
+FAMILY = {
+    TaskType.LINEAR_REGRESSION: "linear",
+    TaskType.LOGISTIC_REGRESSION: "logistic",
+    TaskType.POISSON_REGRESSION: "poisson",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "logistic",
+}
+
+D_FIX, D_RE, N_ENTITIES = 4, 3, 8
+
+
+def _labels(task, z, r):
+    """Task-consistent labels for link-scale scores ``z``."""
+    if task == TaskType.LINEAR_REGRESSION:
+        return (z + 0.1 * r.normal(size=z.shape)).astype(np.float32)
+    if task == TaskType.POISSON_REGRESSION:
+        return r.poisson(np.exp(np.clip(z, -4.0, 3.0))).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-z))
+    return (r.uniform(size=z.shape) < p).astype(np.float32)
+
+
+def make_model(task, scale=1.0, seed=0):
+    r = np.random.default_rng(seed)
+    w_fix = (scale * np.linspace(-1, 1, D_FIX)).astype(np.float32)
+    w_re = (0.5 * scale * r.normal(size=(N_ENTITIES, D_RE))).astype(
+        np.float32
+    )
+    return GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(np.asarray(w_fix)), task),
+            "global",
+        ),
+        "per_user": RandomEffectModel(
+            np.asarray(w_re), "userId", "per_user", task
+        ),
+    })
+
+
+def make_index_maps():
+    return {
+        "global": IndexMap.build([f"g{j}" for j in range(D_FIX)]),
+        "per_user": IndexMap.build([f"r{j}" for j in range(D_RE)]),
+    }
+
+
+def make_entity_index(n=N_ENTITIES):
+    eidx = EntityIndex()
+    for e in range(n):
+        eidx.intern(f"user{e}")
+    return eidx
+
+
+# ---------------------------------------------------------------------------
+# train: coordinate descent converges and beats the null model's loss
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("task", ALL_TASKS, ids=lambda t: t.name)
+def test_family_trains(task):
+    from photon_tpu.estimators.config import (
+        FixedEffectCoordinateConfig,
+        GameOptimizationConfig,
+        RandomEffectCoordinateConfig,
+        RegularizationConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.ops.losses import loss_for_task
+
+    r = np.random.default_rng(11)
+    n, e = 512, N_ENTITIES
+    Xf = r.normal(size=(n, D_FIX)).astype(np.float32)
+    Xr = r.normal(size=(n, D_RE)).astype(np.float32)
+    users = r.integers(0, e, size=n).astype(np.int32)
+    w_true = r.normal(size=D_FIX).astype(np.float32)
+    z = (Xf @ w_true).astype(np.float32)
+    y = _labels(task, z, r)
+
+    batch = GameBatch(
+        label=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+        features={"global": jnp.asarray(Xf), "per_user": jnp.asarray(Xr)},
+        entity_ids={"userId": jnp.asarray(users)},
+    )
+    est = GameEstimator(
+        task=task,
+        coordinate_configs=[
+            FixedEffectCoordinateConfig("global", "global"),
+            RandomEffectCoordinateConfig("per_user", "userId", "per_user"),
+        ],
+        num_iterations=1,
+        num_entities={"userId": e},
+    )
+    cfg = GameOptimizationConfig(reg={
+        "global": RegularizationConfig(weight=1.0),
+        "per_user": RegularizationConfig(weight=10.0),
+    })
+    (res,) = est.fit(batch, optimization_configs=[cfg])
+    scores = np.asarray(res.model.score(batch), np.float32)
+    assert np.all(np.isfinite(scores))
+
+    loss = loss_for_task(task)
+    fit_loss = float(
+        np.mean(np.asarray(loss.value(jnp.asarray(scores), batch.label)))
+    )
+    null_loss = float(
+        np.mean(np.asarray(loss.value(jnp.zeros(n, jnp.float32), batch.label)))
+    )
+    assert np.isfinite(fit_loss)
+    assert fit_loss < null_loss, (task, fit_loss, null_loss)
+
+
+# ---------------------------------------------------------------------------
+# serve + stream: scoring, label join, per-family quality-plane loss
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("task", ALL_TASKS, ids=lambda t: t.name)
+def test_family_serves_and_streams_quality(task, tmp_path):
+    from photon_tpu.serve.engine import ServeConfig, ServingEngine
+    from photon_tpu.serve.frontend import LocalBackend
+    from photon_tpu.stream.spool import FeedbackSpool, SpoolConfig
+
+    r = np.random.default_rng(29)
+    model = make_model(task, seed=3)
+    eng = ServingEngine(
+        model, entity_indexes={"userId": make_entity_index()},
+        index_maps=make_index_maps(),
+        config=ServeConfig(max_batch_size=4), model_version="v1",
+    )
+    # The plane's loss family follows the model's task.
+    assert eng.quality.config.task == FAMILY[task]
+
+    spool = FeedbackSpool(str(tmp_path), SpoolConfig(segment_max_records=64))
+    eng.attach_feedback(spool)
+    backend = LocalBackend(eng)
+    n = 24
+    scores = []
+    for i in range(n):
+        xf = r.normal(size=D_FIX).astype(np.float32)
+        xr = r.normal(size=D_RE).astype(np.float32)
+        res = backend.submit(
+            {"features": {"global": xf.tolist(), "per_user": xr.tolist()},
+             "entityIds": {"userId": f"user{i % N_ENTITIES}"},
+             "uid": f"req-{i}"},
+            tenant=None, priority="interactive",
+        ).result(60.0)
+        scores.append(float(res["score"]))
+    z = np.asarray(scores, np.float32)
+    y = _labels(task, z, r)
+    out = backend.feedback({"labels": [
+        {"uid": f"req-{i}", "label": float(y[i])} for i in range(n)
+    ]})
+    assert out["joined"] == n
+
+    totals = eng.quality.window_totals()
+    acc = None
+    for (version, _tenant, _re), a in totals.items():
+        if version == "v1":
+            acc = a if acc is None else acc.merge(a)
+    assert acc is not None and acc.count == n
+    mean_loss = acc.mean_loss()
+    assert mean_loss is not None and np.isfinite(mean_loss)
+    # Pin the family's loss semantics against a direct computation over
+    # the same (score, label) stream.
+    fam = FAMILY[task]
+    preds = np.asarray([predict(s, fam) for s in z])
+    if fam == "linear":
+        expect = float(np.mean((preds - y) ** 2))
+    elif fam == "poisson":
+        mu = np.maximum(preds, 1e-7)
+        term = np.where(y > 0, y * np.log(np.maximum(y, 1e-12) / mu), 0.0)
+        expect = float(np.mean(2.0 * (term - (y - mu))))
+    else:
+        p = np.clip(preds, 1e-7, 1 - 1e-7)
+        expect = float(np.mean(-(y * np.log(p) + (1 - y) * np.log(1 - p))))
+    assert mean_loss == pytest.approx(expect, rel=1e-5), (task, fam)
+    if fam != "logistic":
+        # Regression-family losses are task losses, not clamped logloss:
+        # they must be non-negative even with labels far outside [0, 1].
+        assert mean_loss >= 0.0
+    eng.close()
+
+
+def test_linear_family_loss_is_squared_error_not_clamped_logloss():
+    """The audit's concrete break: real-valued labels through the 'linear'
+    family must produce squared error — the old path clamped the
+    prediction into (0, 1) and took logloss against labels like 3.7."""
+    from photon_tpu.obs.quality import QualityAccumulator
+
+    acc = QualityAccumulator()
+    acc.observe(pred=3.5, label=3.7, task="linear")
+    acc.observe(pred=-1.0, label=-1.2, task="linear")
+    assert acc.mean_loss() == pytest.approx(
+        ((3.5 - 3.7) ** 2 + (-1.0 + 1.2) ** 2) / 2.0, rel=1e-9
+    )
+
+
+def test_task_name_covers_every_task_type():
+    for task in TaskType:
+        assert task_name(task) in ("linear", "logistic", "poisson")
+    assert task_name(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM) == "logistic"
+    assert task_name(TaskType.POISSON_REGRESSION) == "poisson"
+    assert task_name(TaskType.LINEAR_REGRESSION) == "linear"
+
+
+# ---------------------------------------------------------------------------
+# rollout: manifest gate + shadow + promote per family
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("task", ALL_TASKS, ids=lambda t: t.name)
+def test_family_rollout_gate_shadow_promote(task, tmp_path):
+    from photon_tpu.io.model_io import (
+        gate_and_publish,
+        load_resolved_game_model,
+        save_game_model,
+        write_generation_manifest,
+    )
+    from photon_tpu.serve.engine import ServeConfig, ServingEngine
+
+    root = str(tmp_path)
+    imaps = make_index_maps()
+    eidx = make_entity_index()
+    for shard, imap in imaps.items():
+        imap.save(os.path.join(root, f"index-map-{shard}.json"))
+    eidx.save(os.path.join(root, "entity-index-userId.json"))
+
+    for gen, scale in (("gen-1", 1.0), ("gen-2", 1.1)):
+        save_game_model(
+            make_model(task, scale=scale, seed=5),
+            os.path.join(root, gen), imaps, {"userId": eidx},
+            sparsity_threshold=0.0,
+        )
+        write_generation_manifest(
+            os.path.join(root, gen),
+            parent=None if gen == "gen-1" else "gen-1",
+            holdout_metrics={"AUC": 0.9},
+        )
+        res = gate_and_publish(root, gen)
+        assert res.ok, (task, res.reason)
+
+    # The serialized generation round-trips with its task intact.
+    m1 = load_resolved_game_model(
+        os.path.join(root, "gen-1"), imaps, {"userId": eidx}
+    )
+    for m in m1.models.values():
+        got = getattr(m, "task", None) or m.model.task
+        assert got == task
+
+    eng = ServingEngine(
+        m1, entity_indexes={"userId": eidx}, index_maps=imaps,
+        config=ServeConfig(max_batch_size=4, max_versions=3,
+                           shadow_fraction=1.0),
+        model_version="gen-1",
+    )
+    m2 = load_resolved_game_model(
+        os.path.join(root, "gen-2"), imaps, {"userId": eidx}
+    )
+    eng.load_version(m2, model_version="gen-2")
+    eng.start_shadow("gen-2")
+    from photon_tpu.serve.batcher import ScoreRequest
+
+    r = np.random.default_rng(31)
+    for i in range(8):
+        req = ScoreRequest(
+            {"global": r.normal(size=D_FIX).astype(np.float32),
+             "per_user": r.normal(size=D_RE).astype(np.float32)},
+            {"userId": f"user{i % N_ENTITIES}"},
+        )
+        assert np.isfinite(float(eng.submit(req).result(60.0)))
+    stats = eng.shadow_stats("gen-2")
+    assert stats["count"] == 8
+    eng.promote("gen-2")
+    assert eng.model_version == "gen-2"
+    assert eng.shadow_versions == []
+    eng.close()

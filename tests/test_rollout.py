@@ -378,6 +378,120 @@ def test_engine_shadow_diverge_fault_site():
         eng.close()
 
 
+def _three_version_engine():
+    eng, m1, m2 = _two_version_engine(max_versions=4)
+    m3 = make_model(5.0, seed=3)
+    eng.load_version(m3, "v3")
+    return eng, m1, m2, m3
+
+
+def test_engine_n_way_shadow_lanes_are_independent_and_bit_exact():
+    # ISSUE 20: the experiment plane keeps a whole GP proposal batch
+    # resident as concurrent shadow candidates — every lane must carry its
+    # own sample accumulator, divergence record, and labeled metric series.
+    from photon_tpu.obs.metrics import registry
+
+    eng, m1, m2, m3 = _three_version_engine()
+    try:
+        n = 8
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        ref1 = batch_scores(m1, xa, xb, list(range(n)))
+        ref2 = batch_scores(m2, xa, xb, list(range(n)))
+        ref3 = batch_scores(m3, xa, xb, list(range(n)))
+        before = {
+            v: registry().counter(
+                "serve_shadow_scored_total", model_version=v
+            ).value
+            for v in ("v2", "v3")
+        }
+        eng.start_shadow("v2", fraction=1.0)
+        eng.start_shadow("v3", fraction=1.0)
+        assert eng.shadow_versions == ["v2", "v3"]  # lane start order
+        np.testing.assert_array_equal(_score_all(eng, xa, xb, n), ref1)
+        # Every lane mirrors every primary request at fraction=1.0, and
+        # each lane's samples are bit-exact with its own pinned model.
+        for version, ref in (("v2", ref2), ("v3", ref3)):
+            st = eng.shadow_stats(version)
+            assert st["version"] == version and st["count"] == n
+            samples = eng.shadow_samples(version)
+            np.testing.assert_array_equal(
+                np.asarray([np.float32(s["shadow"]) for s in samples]), ref
+            )
+            np.testing.assert_array_equal(
+                np.asarray([np.float32(s["primary"]) for s in samples]), ref1
+            )
+        # Legacy no-argument view: newest lane's record, plus a candidates
+        # map keyed by version so N lanes never alias into one series.
+        legacy = eng.shadow_stats()
+        assert legacy["version"] == "v3"
+        assert set(legacy["candidates"]) == {"v2", "v3"}
+        assert legacy["candidates"]["v2"]["count"] == n
+        # Per-lane metric labels: each candidate owns its own counter.
+        for v in ("v2", "v3"):
+            got = registry().counter(
+                "serve_shadow_scored_total", model_version=v
+            ).value
+            assert got == before[v] + n
+        assert eng.retraces_since_warmup == 0
+    finally:
+        eng.close()
+
+
+def test_engine_shadow_lanes_sample_fractions_independently():
+    eng, _, _, _ = _three_version_engine()
+    try:
+        n = 16
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        eng.start_shadow("v2", fraction=0.25)
+        eng.start_shadow("v3", fraction=1.0)
+        _score_all(eng, xa, xb, n)
+        # Each lane keeps its own fractional accumulator: exact counts.
+        assert eng.shadow_stats("v2")["count"] == 4
+        assert eng.shadow_stats("v3")["count"] == n
+    finally:
+        eng.close()
+
+
+def test_engine_stop_one_shadow_lane_keeps_the_rest():
+    eng, _, _, _ = _three_version_engine()
+    try:
+        eng.start_shadow("v2", fraction=1.0)
+        eng.start_shadow("v3", fraction=1.0)
+        eng.stop_shadow("v2")
+        assert eng.shadow_versions == ["v3"]
+        eng.stop_shadow()  # legacy no-argument call clears EVERY lane
+        assert eng.shadow_versions == []
+        assert eng.shadow_stats()["version"] is None
+    finally:
+        eng.close()
+
+
+def test_engine_promote_pops_only_the_winning_lane():
+    # Round winner promotes; the losing candidates' lanes must survive so
+    # the next round's observation window keeps its series intact.
+    eng, m1, _, m3 = _three_version_engine()
+    try:
+        n = 6
+        xa = rng.normal(size=(n, D_FIX)).astype(np.float32)
+        xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        eng.start_shadow("v2", fraction=1.0)
+        eng.start_shadow("v3", fraction=1.0)
+        eng.promote("v3")
+        assert eng.model_version == "v3"
+        assert eng.shadow_versions == ["v2"]  # loser keeps shadowing
+        # The surviving lane now diverges against the NEW primary.
+        np.testing.assert_array_equal(
+            _score_all(eng, xa, xb, n),
+            batch_scores(m3, xa, xb, list(range(n))),
+        )
+        assert eng.shadow_stats("v2")["count"] == n
+        assert eng.retraces_since_warmup == 0
+    finally:
+        eng.close()
+
+
 def test_engine_promote_rollback_and_eviction_keeps_parent():
     eng, m1, m2 = _two_version_engine(max_versions=2)
     try:
